@@ -1,0 +1,28 @@
+"""r2d2dpg_tpu — a TPU-native (JAX/XLA/Pallas/pjit) R2D2-DPG framework.
+
+A from-scratch rebuild of the capabilities of ``jinbeizame007/pytorch-r2d2-DPG``
+(see SURVEY.md for the structural analysis and its provenance note: the
+reference mount was empty at survey time, so component parity is tracked
+against SURVEY.md §2 and BASELINE.json's five capability configs rather than
+reference ``file:line`` citations).
+
+Architecture (SURVEY.md §7, "design inversion"): the reference's process
+topology — N CPU actor processes feeding a CUDA learner over
+``multiprocessing.Queue`` — dissolves into a single-controller JAX program in
+the Podracer/Anakin style (PAPERS.md, arxiv 2104.06272):
+
+- ``envs``      — pure-JAX environments (on-device) and a host-callback pool
+                  for MuJoCo-backed DM-Control tasks.
+- ``models``    — flax actor/critic networks: MLP, LSTM (carried-state), CNN.
+- ``ops``       — pure update math: n-step targets, eta-mix priorities,
+                  IS weights, Polyak, exploration-noise ladder; Pallas kernels.
+- ``replay``    — HBM-resident prioritized sequence replay arena.
+- ``agents``    — the DDPG/R2D2 learner step as one jittable function.
+- ``training``  — actor phase (vmapped env stepping + sequence assembly) and
+                  the outer Anakin loop.
+- ``parallel``  — device mesh + shard_map SPMD: env batch and replay sharded
+                  over the ``dp`` axis, gradient psum over ICI.
+- ``utils``     — configs, checkpointing (orbax), metrics/logging.
+"""
+
+__version__ = "0.1.0"

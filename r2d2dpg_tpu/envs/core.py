@@ -1,0 +1,79 @@
+"""Environment API: pure functional, scan/vmap-friendly.
+
+Reference parity: the reference steps ``gym``/``dm_control`` envs inside N
+actor processes (SURVEY.md §2.3, §3.2).  TPU-natively the env is a pure
+function so a *batch* of envs is one ``vmap`` and a rollout is one
+``lax.scan`` — the whole actor fleet becomes one XLA program (SURVEY §7,
+BASELINE north star "vmapped on-device environment stepper").
+
+Two families implement this API:
+
+- pure-JAX dynamics (``pendulum.py``) — fully on-device;
+- host-callback pools (``dmc_host.py``) — MuJoCo physics steps on host CPU
+  via ``io_callback`` while everything else stays on-device (no MJX in this
+  image; SURVEY §7 step 5 track (b)).
+
+Auto-reset contract: ``step`` returns a ``TimeStep`` whose ``reset`` flag is 1
+when the *returned observation* begins a new episode (the env auto-resets
+internally).  ``reward``/``discount`` always describe the transition taken
+*before* any auto-reset, so the pair (obs_t, reset_t) aligns with how the
+networks consume them (zero LSTM state where reset=1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Protocol, Tuple
+
+import jax
+import jax.numpy as jnp
+
+EnvState = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TimeStep:
+    """One env step's outputs, batched or not.
+
+    obs: observation that *follows* the transition (post-auto-reset).
+    reward: reward of the transition taken before any auto-reset (so the
+      episode's final reward rides on the step whose ``reset`` flag is 1;
+      only ``reset()``'s first TimeStep carries reward 0).
+    discount: continuation flag in [0, 1]; 0 when the episode terminated.
+    reset: 1 when ``obs`` is the first observation of a new episode.
+    """
+
+    obs: jnp.ndarray
+    reward: jnp.ndarray
+    discount: jnp.ndarray
+    reset: jnp.ndarray
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvSpec:
+    """Static env metadata."""
+
+    name: str
+    obs_shape: Tuple[int, ...]
+    action_dim: int
+    action_min: float = -1.0
+    action_max: float = 1.0
+    episode_length: int = 1000
+    pixels: bool = False
+
+
+class Environment(Protocol):
+    """Functional environment protocol."""
+
+    spec: EnvSpec
+
+    def reset(self, key: jax.Array) -> Tuple[EnvState, TimeStep]:
+        """Fresh episode -> (state, first TimeStep with reset=1, reward=0)."""
+        ...
+
+    def step(
+        self, state: EnvState, action: jnp.ndarray, key: jax.Array
+    ) -> Tuple[EnvState, TimeStep]:
+        """Advance one step, auto-resetting on episode end."""
+        ...

@@ -1,0 +1,103 @@
+"""Pure-JAX Pendulum-v1 (gymnasium classic-control dynamics).
+
+BASELINE configs #1-2 run on Pendulum; implementing the ~40-LoC dynamics in
+JAX keeps the whole loop one jit graph from day one (SURVEY.md §7 step 3).
+Dynamics match gymnasium's ``PendulumEnv`` (g=10, m=1, l=1, dt=0.05, torque
+in [-2, 2], reward = -(theta^2 + 0.1*thdot^2 + 0.001*u^2), 200-step episodes,
+time-limit truncation only — never termination, so ``discount`` stays 1 and
+bootstrapping through the limit is correct).
+
+Envs take canonical actions in [-1, 1] (the tanh policy range) and rescale
+internally; ``spec`` records the true torque range.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from r2d2dpg_tpu.envs.core import EnvSpec, TimeStep
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PendulumState:
+    theta: jnp.ndarray
+    thdot: jnp.ndarray
+    t: jnp.ndarray  # step count within the episode
+
+
+def _angle_normalize(x):
+    return ((x + jnp.pi) % (2.0 * jnp.pi)) - jnp.pi
+
+
+class Pendulum:
+    """Functional Pendulum-v1. All methods are pure; vmap/scan freely."""
+
+    MAX_TORQUE = 2.0
+    MAX_SPEED = 8.0
+    DT = 0.05
+    G = 10.0
+
+    def __init__(self, episode_length: int = 200):
+        self.spec = EnvSpec(
+            name="Pendulum-v1",
+            obs_shape=(3,),
+            action_dim=1,
+            action_min=-self.MAX_TORQUE,
+            action_max=self.MAX_TORQUE,
+            episode_length=episode_length,
+        )
+
+    def _obs(self, s: PendulumState) -> jnp.ndarray:
+        return jnp.stack([jnp.cos(s.theta), jnp.sin(s.theta), s.thdot], axis=-1)
+
+    def _init_state(self, key: jax.Array) -> PendulumState:
+        k1, k2 = jax.random.split(key)
+        return PendulumState(
+            theta=jax.random.uniform(k1, (), minval=-jnp.pi, maxval=jnp.pi),
+            thdot=jax.random.uniform(k2, (), minval=-1.0, maxval=1.0),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def reset(self, key: jax.Array) -> Tuple[PendulumState, TimeStep]:
+        s = self._init_state(key)
+        ts = TimeStep(
+            obs=self._obs(s),
+            reward=jnp.zeros(()),
+            discount=jnp.ones(()),
+            reset=jnp.ones(()),
+        )
+        return s, ts
+
+    def step(
+        self, state: PendulumState, action: jnp.ndarray, key: jax.Array
+    ) -> Tuple[PendulumState, TimeStep]:
+        u = jnp.clip(action[..., 0], -1.0, 1.0) * self.MAX_TORQUE
+        th, thdot = state.theta, state.thdot
+        cost = _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
+
+        newthdot = thdot + (
+            3.0 * self.G / 2.0 * jnp.sin(th) + 3.0 * u
+        ) * self.DT
+        newthdot = jnp.clip(newthdot, -self.MAX_SPEED, self.MAX_SPEED)
+        newth = th + newthdot * self.DT
+        t = state.t + 1
+
+        done = t >= self.spec.episode_length
+        fresh = self._init_state(key)
+        nxt = PendulumState(
+            theta=jnp.where(done, fresh.theta, newth),
+            thdot=jnp.where(done, fresh.thdot, newthdot),
+            t=jnp.where(done, fresh.t, t),
+        )
+        ts = TimeStep(
+            obs=self._obs(nxt),
+            reward=-cost,
+            discount=jnp.ones(()),  # truncation, not termination
+            reset=done.astype(jnp.float32),
+        )
+        return nxt, ts

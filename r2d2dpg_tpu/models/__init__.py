@@ -1,0 +1,20 @@
+"""Actor/critic networks (SURVEY.md §2.1): MLP, LSTM carried-state, CNN torso."""
+
+from r2d2dpg_tpu.models.actor_critic import (
+    ActorNet,
+    CriticNet,
+    time_major,
+    unroll,
+    zeros_where_reset,
+)
+from r2d2dpg_tpu.models.torsos import ConvTorso, MLPTorso
+
+__all__ = [
+    "ActorNet",
+    "ConvTorso",
+    "CriticNet",
+    "MLPTorso",
+    "time_major",
+    "unroll",
+    "zeros_where_reset",
+]

@@ -1,0 +1,181 @@
+"""Actor (deterministic policy) and Critic (Q) networks with carried LSTM state.
+
+Reference parity: SURVEY.md §2.1 / §3.4 —
+
+- ``ActorNet``: obs -> tanh-squashed deterministic action in
+  [-action_scale, action_scale]; encoder -> LSTM core -> output head.
+- ``CriticNet``: (obs, action) -> scalar Q; the action enters after the first
+  encoder layer (SURVEY §3.4: "action enters after layer 1").
+- Both take and return recurrent state ``(h, c)`` **carried by the caller** —
+  THE defining R2D2 detail (SURVEY §2.1): the actor phase threads it per env
+  step and stores it into replay; the learner re-initializes from *stored*
+  state and burns in.
+- Feedforward variants (``use_lstm=False``, BASELINE config #1) keep the same
+  carried-state API with an empty carry, so actor/learner code is uniform.
+- Episode boundaries: the carry is zeroed where ``reset`` is set *before* the
+  cell runs (SURVEY §2.1 "per-step hidden-state reset on episode boundary").
+
+TPU notes: the single-step call is what the actor phase vmaps over envs; the
+learner unrolls it with ``lax.scan`` over time (SURVEY §2.9 — burn-in+unroll
+as one jitted scan instead of cuDNN LSTM calls).  All matmuls are MXU-shaped
+([B, hidden] x [hidden, 4*hidden]); ``dtype=bfloat16`` is supported
+throughout with float32 params.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from r2d2dpg_tpu.models.torsos import (
+    ConvTorso,
+    MLPTorso,
+    fan_in_uniform,
+    symmetric_uniform,
+)
+
+# Carry is a pytree: () for feedforward nets, flax's (c, h) tuple for LSTM.
+Carry = Any
+
+
+def lstm_initial_carry(batch_size: int, hidden: int, use_lstm: bool) -> Carry:
+    """Fresh carry for a net: flax's (c, h) zeros for LSTM, () for feedforward."""
+    if not use_lstm:
+        return ()
+    zeros = jnp.zeros((batch_size, hidden), jnp.float32)
+    return (zeros, zeros)
+
+
+def zeros_where_reset(carry: Carry, reset: jnp.ndarray) -> Carry:
+    """Zero the recurrent state for batch rows where ``reset`` is truthy."""
+    if not jax.tree_util.tree_leaves(carry):
+        return carry
+    mask = reset.astype(bool)
+
+    def _mask(x):
+        return jnp.where(mask.reshape(mask.shape + (1,) * (x.ndim - mask.ndim)), 0, x)
+
+    return jax.tree_util.tree_map(_mask, carry)
+
+
+class _Core(nn.Module):
+    """Shared recurrent-or-dense core: LSTM cell when ``use_lstm`` else Dense."""
+
+    hidden: int
+    use_lstm: bool
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, carry: Carry, reset: jnp.ndarray):
+        if self.use_lstm:
+            carry = zeros_where_reset(carry, reset)
+            carry, y = nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype)(carry, x)
+            return y, carry
+        y = nn.relu(
+            nn.Dense(self.hidden, kernel_init=fan_in_uniform(), dtype=self.dtype)(x)
+        )
+        return y, carry
+
+
+def _make_torso(pixels: bool, hidden: int, dtype: Any) -> nn.Module:
+    if pixels:
+        return ConvTorso(out_size=hidden, dtype=dtype)
+    return MLPTorso(layer_sizes=(hidden,), dtype=dtype)
+
+
+class ActorNet(nn.Module):
+    """Deterministic policy mu(obs) with optional LSTM core."""
+
+    action_dim: int
+    hidden: int = 256
+    use_lstm: bool = True
+    pixels: bool = False
+    action_scale: float = 1.0
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.torso = _make_torso(self.pixels, self.hidden, self.dtype)
+        self.core = _Core(self.hidden, self.use_lstm, self.dtype)
+        self.head = nn.Dense(
+            self.action_dim, kernel_init=symmetric_uniform(3e-3), dtype=self.dtype
+        )
+
+    def __call__(
+        self, obs: jnp.ndarray, carry: Carry, reset: jnp.ndarray
+    ) -> Tuple[jnp.ndarray, Carry]:
+        """Single step: obs [B, ...], reset [B] -> (action [B, A], new carry)."""
+        x = self.torso(obs)
+        y, carry = self.core(x, carry, reset)
+        action = jnp.tanh(self.head(y)).astype(jnp.float32) * self.action_scale
+        return action, carry
+
+    def initial_carry(self, batch_size: int) -> Carry:
+        return lstm_initial_carry(batch_size, self.hidden, self.use_lstm)
+
+
+class CriticNet(nn.Module):
+    """Q(obs, action) with optional LSTM core; action concatenated after layer 1."""
+
+    hidden: int = 256
+    use_lstm: bool = True
+    pixels: bool = False
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.torso = _make_torso(self.pixels, self.hidden, self.dtype)
+        self.mix = nn.Dense(
+            self.hidden, kernel_init=fan_in_uniform(), dtype=self.dtype
+        )
+        self.core = _Core(self.hidden, self.use_lstm, self.dtype)
+        self.head = nn.Dense(1, kernel_init=symmetric_uniform(3e-3), dtype=self.dtype)
+
+    def __call__(
+        self,
+        obs: jnp.ndarray,
+        action: jnp.ndarray,
+        carry: Carry,
+        reset: jnp.ndarray,
+    ) -> Tuple[jnp.ndarray, Carry]:
+        """Single step -> (q [B], new carry)."""
+        x = self.torso(obs)
+        x = nn.relu(self.mix(jnp.concatenate([x, action.astype(x.dtype)], axis=-1)))
+        y, carry = self.core(x, carry, reset)
+        q = self.head(y).astype(jnp.float32)
+        return jnp.squeeze(q, axis=-1), carry
+
+    def initial_carry(self, batch_size: int) -> Carry:
+        return lstm_initial_carry(batch_size, self.hidden, self.use_lstm)
+
+
+def unroll(
+    apply_step: Callable[..., Tuple[jnp.ndarray, Carry]],
+    carry: Carry,
+    *step_inputs: jnp.ndarray,
+) -> Tuple[jnp.ndarray, Carry]:
+    """Unroll a single-step net over time with ``lax.scan``.
+
+    Args:
+      apply_step: closure ``(carry, *inputs_t) -> (out_t, carry)`` — e.g.
+        ``lambda c, obs, reset: actor.apply(params, obs, c, reset)``.
+      carry: initial recurrent state.
+      *step_inputs: time-major arrays ``[T, B, ...]`` passed per step.
+
+    Returns:
+      ``(outputs [T, ...], final_carry)``.
+    """
+
+    def step(c, inputs):
+        out, c = apply_step(c, *inputs)
+        return c, out
+
+    carry, outs = lax.scan(step, carry, step_inputs)
+    return outs, carry
+
+
+def time_major(x: jnp.ndarray) -> jnp.ndarray:
+    """[B, T, ...] -> [T, B, ...] (replay is batch-major; scan is time-major)."""
+    return jnp.swapaxes(x, 0, 1)

@@ -1,0 +1,75 @@
+"""Observation torsos: MLP encoder and CNN (pixels) encoder.
+
+Reference parity: SURVEY.md §2.1 — MLP encoder feeding the LSTM for state
+observations; a Conv2d stack -> flatten -> LSTM for the from-pixels config
+(BASELINE config #5).  Weight init follows the DDPG convention (fan-in
+uniform; SURVEY §2.1 "Weight init" row).
+
+TPU notes: convs and the big dense layers run on the MXU; ``dtype`` lets the
+whole torso compute in bfloat16 while keeping parameters in float32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def fan_in_uniform():
+    """U(-1/sqrt(fan_in), 1/sqrt(fan_in)) — the canonical DDPG hidden init."""
+    return nn.initializers.variance_scaling(1.0 / 3.0, "fan_in", "uniform")
+
+
+def symmetric_uniform(scale: float):
+    """U(-scale, scale) — the canonical DDPG final-layer init (3e-3)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        return nn.initializers.uniform(2.0 * scale)(key, shape, dtype) - scale
+
+    return init
+
+
+class MLPTorso(nn.Module):
+    """ReLU MLP over flat observations."""
+
+    layer_sizes: Sequence[int] = (256,)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> jnp.ndarray:
+        x = obs.astype(self.dtype)
+        for size in self.layer_sizes:
+            x = nn.relu(
+                nn.Dense(size, kernel_init=fan_in_uniform(), dtype=self.dtype)(x)
+            )
+        return x
+
+
+class ConvTorso(nn.Module):
+    """Nature-DQN-style CNN for pixel observations ([B, H, W, C], uint8 or float)."""
+
+    out_size: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> jnp.ndarray:
+        x = obs.astype(self.dtype)
+        if obs.dtype == jnp.uint8:
+            x = x / 255.0
+        for features, kernel, stride in ((32, 8, 4), (64, 4, 2), (64, 3, 1)):
+            x = nn.relu(
+                nn.Conv(
+                    features,
+                    (kernel, kernel),
+                    strides=(stride, stride),
+                    padding="VALID",
+                    dtype=self.dtype,
+                )(x)
+            )
+        x = x.reshape(x.shape[:-3] + (-1,))
+        x = nn.relu(
+            nn.Dense(self.out_size, kernel_init=fan_in_uniform(), dtype=self.dtype)(x)
+        )
+        return x

@@ -1,0 +1,26 @@
+"""Pure update math (SURVEY.md §7 step 2): unit-tested before anything learns."""
+
+from r2d2dpg_tpu.ops.noise import gaussian_noise, ou_step, sigma_ladder
+from r2d2dpg_tpu.ops.polyak import hard_update, polyak_update
+from r2d2dpg_tpu.ops.priority import (
+    PRIORITY_EPS,
+    anneal_beta,
+    importance_weights,
+    sequence_priority,
+)
+from r2d2dpg_tpu.ops.returns import huber, n_step_targets, td_errors
+
+__all__ = [
+    "PRIORITY_EPS",
+    "anneal_beta",
+    "gaussian_noise",
+    "hard_update",
+    "huber",
+    "importance_weights",
+    "n_step_targets",
+    "ou_step",
+    "polyak_update",
+    "sequence_priority",
+    "sigma_ladder",
+    "td_errors",
+]

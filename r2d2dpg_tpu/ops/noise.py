@@ -1,0 +1,72 @@
+"""Exploration noise: per-actor sigma ladder and Gaussian/OU processes.
+
+Reference parity: SURVEY.md §2.3 — each actor ``i`` of ``N`` gets its own
+noise scale (the continuous-control analogue of Ape-X's per-actor epsilon
+ladder, arxiv 1803.00933 §D): a geometric ladder
+``sigma_i = sigma_max ** (1 + alpha * i / (N - 1))`` by default, with a linear
+option.  In the Anakin layout the "actors" are lanes of a vmapped env batch,
+so the ladder is just a ``[num_envs]`` vector of scales.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sigma_ladder(
+    num_actors: int,
+    *,
+    sigma_max: float = 0.4,
+    alpha: float = 7.0,
+    kind: str = "geometric",
+    sigma_min: float = 0.05,
+) -> jnp.ndarray:
+    """Per-actor exploration scales, shape ``[num_actors]``.
+
+    ``geometric``: sigma_i = sigma_max ** (1 + alpha * i/(N-1))  (Ape-X style —
+    scales decay geometrically from sigma_max towards sigma_max**(1+alpha)).
+    ``linear``: evenly spaced in [sigma_min, sigma_max].
+    ``constant``: sigma_max everywhere.
+    """
+    if num_actors < 1:
+        raise ValueError("num_actors must be >= 1")
+    i = jnp.arange(num_actors, dtype=jnp.float32)
+    denom = max(num_actors - 1, 1)
+    if kind == "geometric":
+        return sigma_max ** (1.0 + alpha * i / denom)
+    if kind == "linear":
+        if num_actors == 1:
+            return jnp.full((1,), sigma_max)
+        return sigma_min + (sigma_max - sigma_min) * (1.0 - i / denom)
+    if kind == "constant":
+        return jnp.full((num_actors,), sigma_max)
+    raise ValueError(f"unknown ladder kind: {kind}")
+
+
+def gaussian_noise(key: jax.Array, action: jnp.ndarray, sigma: jnp.ndarray) -> jnp.ndarray:
+    """Additive Gaussian noise; ``sigma`` broadcasts over the action axis."""
+    return jnp.asarray(sigma)[..., None] * jax.random.normal(
+        key, action.shape, action.dtype
+    )
+
+
+def ou_step(
+    key: jax.Array,
+    noise_state: jnp.ndarray,
+    sigma: jnp.ndarray,
+    *,
+    theta: float = 0.15,
+    dt: float = 1e-2,
+) -> jnp.ndarray:
+    """One Ornstein-Uhlenbeck step; returns the new noise state (== the noise).
+
+    ``x' = x - theta*x*dt + sigma*sqrt(dt)*N(0,1)`` — the classic DDPG
+    exploration process (Lillicrap et al. 2015); reset the state to zeros at
+    episode boundaries.
+    """
+    drift = -theta * noise_state * dt
+    diffusion = jnp.asarray(sigma)[..., None] * jnp.sqrt(dt) * jax.random.normal(
+        key, noise_state.shape, noise_state.dtype
+    )
+    return noise_state + drift + diffusion

@@ -1,0 +1,26 @@
+"""Target-network soft (Polyak) update.
+
+Reference parity: SURVEY.md §2.4 "soft target update" — ``theta' <- tau*theta
++ (1-tau)*theta'`` every learner step, tau ~ 5e-3 (BASELINE config #4 names
+soft-update explicitly).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def polyak_update(online, target, tau: float):
+    """``target <- tau * online + (1 - tau) * target`` over a pytree."""
+    return jax.tree_util.tree_map(
+        lambda o, t: tau * o + (1.0 - tau) * t, online, target
+    )
+
+
+def hard_update(online, target):
+    """Target becomes the online params (initialization / periodic sync).
+
+    JAX arrays are immutable, so returning ``online`` is a true snapshot.
+    """
+    del target
+    return online

@@ -1,0 +1,52 @@
+"""Prioritized-replay math: eta-mix sequence priority and IS weights.
+
+Reference parity: SURVEY.md §2.2 — proportional prioritization with
+``p_i^alpha / sum p^alpha`` sampling, importance weights
+``w_i = (N * P(i))^-beta`` normalized by the max, and R2D2's sequence priority
+``p = eta * max_t |delta_t| + (1 - eta) * mean_t |delta_t|`` with eta ~ 0.9
+(SURVEY §0, tag [ALGO], Kapturowski et al. 2019).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Keeps every stored sequence sampleable and priorities strictly positive.
+PRIORITY_EPS = 1e-6
+
+
+def sequence_priority(
+    td: jnp.ndarray, *, eta: float = 0.9, axis: int = -1
+) -> jnp.ndarray:
+    """R2D2 eta-mix of max and mean absolute TD error along ``axis``."""
+    abs_td = jnp.abs(td)
+    return (
+        eta * abs_td.max(axis=axis)
+        + (1.0 - eta) * abs_td.mean(axis=axis)
+        + PRIORITY_EPS
+    )
+
+
+def importance_weights(
+    probs: jnp.ndarray, size: jnp.ndarray | int, *, beta: float
+) -> jnp.ndarray:
+    """Normalized importance-sampling weights for sampled probabilities.
+
+    ``w_i = (N * P(i))^-beta / max_j w_j`` — the max is taken over the sampled
+    batch (the standard cheap approximation; the true max over the buffer would
+    need the min-probability, which a flat-priority layout makes a full scan).
+
+    Args:
+      probs: ``[B]`` probabilities with which each sampled item was drawn.
+      size: current number of valid items in the buffer (N).
+      beta: IS exponent (0 = no correction, 1 = full).
+    """
+    size = jnp.maximum(jnp.asarray(size, jnp.float32), 1.0)
+    w = (size * jnp.maximum(probs, 1e-12)) ** (-beta)
+    return w / jnp.maximum(w.max(), 1e-12)
+
+
+def anneal_beta(step: jnp.ndarray, *, beta0: float, steps: int) -> jnp.ndarray:
+    """Linear beta annealing beta0 -> 1 over ``steps`` learner updates."""
+    frac = jnp.clip(jnp.asarray(step, jnp.float32) / max(steps, 1), 0.0, 1.0)
+    return beta0 + (1.0 - beta0) * frac

@@ -1,0 +1,81 @@
+"""n-step TD targets and TD errors (pure functions).
+
+Reference parity: SURVEY.md §2.4 "n-step targets" row — the reference learner
+computes ``y_t = sum_{k<n} gamma^k r_{t+k} + gamma^n Q_tgt(s_{t+n},
+mu_tgt(s_{t+n}))`` over the training unroll (reference source unavailable;
+formula is forced by the DDPG/R2D2 algorithm, tag [ALGO]).
+
+Conventions
+-----------
+A stored sequence step ``t`` holds ``(obs_t, a_t, r_t, d_t)`` where ``r_t`` is
+the reward received after executing ``a_t`` in ``obs_t`` and ``d_t`` in
+``{0., 1.}`` is the *continuation* flag: 0 if the episode terminated at the
+transition ``t -> t+1``.  A sequence of length ``burnin + unroll + n`` gives
+every step of the training window ``[burnin, burnin+unroll)`` a full n-step
+target; the trailing ``n`` steps contribute only rewards and the bootstrap.
+
+Everything here is shape-static and jit/vmap/scan friendly: the n-step loop is
+a Python loop over the *static* ``n`` (unrolled at trace time onto the MXU-fed
+fused elementwise path), not a dynamic loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def n_step_targets(
+    rewards: jnp.ndarray,
+    discounts: jnp.ndarray,
+    bootstrap_q: jnp.ndarray,
+    *,
+    n: int,
+    gamma: float,
+) -> jnp.ndarray:
+    """Compute n-step TD targets along the trailing time axis.
+
+    Args:
+      rewards: ``[..., U + n]`` per-step rewards ``r_t``.
+      discounts: ``[..., U + n]`` continuation flags ``d_t`` (0 at terminal
+        transitions, else 1; any value in [0, 1] works, e.g. absorbing-state
+        discounts).
+      bootstrap_q: ``[..., U + n]`` per-step bootstrap values
+        ``q_t = Q_tgt(s_t, mu_tgt(s_t))`` aligned with ``rewards`` — the
+        target at window position ``t`` bootstraps from ``bootstrap_q[t+n]``.
+      n: number of reward steps (static).
+      gamma: discount factor.
+
+    Returns:
+      ``[..., U]`` targets ``y_t`` for the first ``U = T - n`` positions:
+
+        y_t = sum_{k=0}^{n-1} gamma^k (prod_{j<k} d_{t+j}) r_{t+k}
+              + gamma^n (prod_{j<n} d_{t+j}) q_{t+n}
+    """
+    T = rewards.shape[-1]
+    U = T - n
+    if U <= 0:
+        raise ValueError(f"sequence time axis {T} must exceed n_step {n}")
+
+    def tslice(x, k):
+        return lax.slice_in_dim(x, k, k + U, axis=-1)
+
+    cont = jnp.ones_like(tslice(rewards, 0))
+    acc = jnp.zeros_like(cont)
+    for k in range(n):
+        acc = acc + (gamma**k) * cont * tslice(rewards, k)
+        cont = cont * tslice(discounts, k)
+    acc = acc + (gamma**n) * cont * tslice(bootstrap_q, n)
+    return acc
+
+
+def td_errors(q_values: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Per-step TD errors ``delta_t = y_t - Q(s_t, a_t)`` (targets detached upstream)."""
+    return targets - q_values
+
+
+def huber(x: jnp.ndarray, delta: float = 1.0) -> jnp.ndarray:
+    """Huber loss element-wise; reference uses MSE/Huber on (Q - y) (SURVEY §2.4)."""
+    abs_x = jnp.abs(x)
+    quad = jnp.minimum(abs_x, delta)
+    return 0.5 * quad**2 + delta * (abs_x - quad)

@@ -1,0 +1,19 @@
+"""Test configuration: run on a virtual 8-device CPU mesh (SURVEY.md §4.4).
+
+Multi-chip TPU hardware is unavailable in CI; all sharding/collective code
+paths execute on 8 virtual CPU devices via
+``--xla_force_host_platform_device_count``.  Must be set before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")

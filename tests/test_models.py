@@ -1,0 +1,128 @@
+"""Model tests: shapes, carried state, reset masking, scan-vs-loop equivalence
+(SURVEY.md §4.1-4.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.models import ActorNet, CriticNet, time_major, unroll
+
+
+B, OBS, ACT, HID = 3, 5, 2, 32
+
+
+def make_actor(use_lstm=True, pixels=False):
+    net = ActorNet(action_dim=ACT, hidden=HID, use_lstm=use_lstm, pixels=pixels)
+    obs = jnp.zeros((B, 64, 64, 3)) if pixels else jnp.zeros((B, OBS))
+    carry = net.initial_carry(B)
+    params = net.init(jax.random.PRNGKey(0), obs, carry, jnp.zeros(B))
+    return net, params, carry, obs
+
+
+def make_critic(use_lstm=True):
+    net = CriticNet(hidden=HID, use_lstm=use_lstm)
+    obs, act = jnp.zeros((B, OBS)), jnp.zeros((B, ACT))
+    carry = net.initial_carry(B)
+    params = net.init(jax.random.PRNGKey(0), obs, act, carry, jnp.zeros(B))
+    return net, params, carry
+
+
+@pytest.mark.parametrize("use_lstm", [True, False])
+def test_actor_shapes_and_bounds(use_lstm):
+    net, params, carry, _ = make_actor(use_lstm)
+    obs = jax.random.normal(jax.random.PRNGKey(1), (B, OBS)) * 10
+    a, carry2 = net.apply(params, obs, carry, jnp.zeros(B))
+    assert a.shape == (B, ACT)
+    assert np.all(np.abs(np.asarray(a)) <= 1.0)
+    if use_lstm:
+        assert jax.tree_util.tree_leaves(carry2)[0].shape == (B, HID)
+    else:
+        assert carry2 == ()
+
+
+@pytest.mark.parametrize("use_lstm", [True, False])
+def test_critic_shapes(use_lstm):
+    net, params, carry = make_critic(use_lstm)
+    obs = jax.random.normal(jax.random.PRNGKey(1), (B, OBS))
+    act = jax.random.normal(jax.random.PRNGKey(2), (B, ACT))
+    q, _ = net.apply(params, obs, act, carry, jnp.zeros(B))
+    assert q.shape == (B,)
+
+
+def test_pixel_actor():
+    net, params, carry, obs = make_actor(pixels=True)
+    a, _ = net.apply(
+        params,
+        jnp.zeros((B, 64, 64, 3), jnp.uint8),
+        carry,
+        jnp.zeros(B),
+    )
+    assert a.shape == (B, ACT)
+
+
+def test_lstm_state_changes_and_affects_output():
+    net, params, carry, _ = make_actor()
+    obs = jax.random.normal(jax.random.PRNGKey(1), (B, OBS))
+    a1, carry1 = net.apply(params, obs, carry, jnp.zeros(B))
+    a2, _ = net.apply(params, obs, carry1, jnp.zeros(B))
+    # Same obs, different carry -> different action (state matters).
+    assert not np.allclose(np.asarray(a1), np.asarray(a2))
+
+
+def test_reset_masks_carry_per_row():
+    net, params, carry, _ = make_actor()
+    obs = jax.random.normal(jax.random.PRNGKey(1), (B, OBS))
+    _, carry1 = net.apply(params, obs, carry, jnp.zeros(B))
+    # Row 0 resets: its step must equal a from-zero-state step.
+    reset = jnp.array([1.0, 0.0, 0.0])
+    a_mixed, _ = net.apply(params, obs, carry1, reset)
+    a_zero, _ = net.apply(params, obs, carry, jnp.zeros(B))
+    np.testing.assert_allclose(
+        np.asarray(a_mixed)[0], np.asarray(a_zero)[0], rtol=1e-5, atol=1e-6
+    )
+    assert not np.allclose(np.asarray(a_mixed)[1], np.asarray(a_zero)[1])
+
+
+def test_unroll_equals_step_loop():
+    """lax.scan unroll == step-by-step python loop (SURVEY §4.2)."""
+    net, params, carry, _ = make_actor()
+    T = 7
+    obs_seq = jax.random.normal(jax.random.PRNGKey(3), (T, B, OBS))
+    resets = jnp.zeros((T, B)).at[3, 1].set(1.0)
+
+    outs, final = unroll(
+        lambda c, o, r: net.apply(params, o, c, r), carry, obs_seq, resets
+    )
+
+    c = carry
+    expected = []
+    for t in range(T):
+        a, c = net.apply(params, obs_seq[t], c, resets[t])
+        expected.append(a)
+    np.testing.assert_allclose(
+        np.asarray(outs), np.asarray(jnp.stack(expected)), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(jax.tree_util.tree_leaves(final)[0]),
+        np.asarray(jax.tree_util.tree_leaves(c)[0]),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_time_major():
+    x = jnp.zeros((4, 9, 2))
+    assert time_major(x).shape == (9, 4, 2)
+
+
+def test_jit_no_retrace():
+    """Every jitted step compiles once across calls (SURVEY §4.2)."""
+    net, params, carry, _ = make_actor()
+    step = jax.jit(lambda p, o, c, r: net.apply(p, o, c, r))
+    obs = jnp.zeros((B, OBS))
+    step(params, obs, carry, jnp.zeros(B))
+    n0 = step._cache_size()
+    for _ in range(3):
+        _, carry = step(params, obs, carry, jnp.zeros(B))
+    assert step._cache_size() == n0 == 1

@@ -1,0 +1,69 @@
+"""Priority eta-mix, IS weights, noise ladder, Polyak (SURVEY.md §4.1)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2dpg_tpu.ops import (
+    PRIORITY_EPS,
+    anneal_beta,
+    importance_weights,
+    polyak_update,
+    sequence_priority,
+    sigma_ladder,
+)
+
+
+def test_sequence_priority_eta_mix():
+    td = jnp.array([[1.0, -3.0, 2.0]])
+    p = sequence_priority(td, eta=0.9)
+    want = 0.9 * 3.0 + 0.1 * 2.0 + PRIORITY_EPS
+    np.testing.assert_allclose(np.asarray(p), [want], rtol=1e-6)
+
+
+def test_sequence_priority_eta_extremes():
+    td = jnp.array([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(
+        float(sequence_priority(td, eta=1.0)), 3.0 + PRIORITY_EPS, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(sequence_priority(td, eta=0.0)), 2.0 + PRIORITY_EPS, rtol=1e-6
+    )
+
+
+def test_importance_weights_formula_and_normalization():
+    probs = jnp.array([0.5, 0.25, 0.25])
+    w = importance_weights(probs, size=100, beta=0.4)
+    raw = (100 * np.array([0.5, 0.25, 0.25])) ** (-0.4)
+    np.testing.assert_allclose(np.asarray(w), raw / raw.max(), rtol=1e-5)
+    assert float(w.max()) == 1.0
+
+
+def test_importance_weights_beta_zero_is_uniform():
+    w = importance_weights(jnp.array([0.9, 0.1]), size=10, beta=0.0)
+    np.testing.assert_allclose(np.asarray(w), [1.0, 1.0])
+
+
+def test_anneal_beta():
+    np.testing.assert_allclose(float(anneal_beta(0, beta0=0.4, steps=100)), 0.4, rtol=1e-6)
+    np.testing.assert_allclose(float(anneal_beta(50, beta0=0.4, steps=100)), 0.7, rtol=1e-6)
+    np.testing.assert_allclose(float(anneal_beta(1000, beta0=0.4, steps=100)), 1.0, rtol=1e-6)
+
+
+def test_sigma_ladder_geometric_monotone():
+    s = np.asarray(sigma_ladder(8, sigma_max=0.4, alpha=7.0))
+    assert s[0] == np.float32(0.4)
+    assert np.all(np.diff(s) < 0)  # decays toward tiny sigma
+    np.testing.assert_allclose(s[-1], 0.4**8, rtol=1e-5)
+
+
+def test_sigma_ladder_single_actor_and_linear():
+    assert np.asarray(sigma_ladder(1, sigma_max=0.3)) == np.float32(0.3)
+    lin = np.asarray(sigma_ladder(4, kind="linear", sigma_max=0.4, sigma_min=0.1))
+    np.testing.assert_allclose(lin, [0.4, 0.3, 0.2, 0.1], rtol=1e-5)
+
+
+def test_polyak_update():
+    online = {"w": jnp.ones(3)}
+    target = {"w": jnp.zeros(3)}
+    new = polyak_update(online, target, tau=0.1)
+    np.testing.assert_allclose(np.asarray(new["w"]), 0.1 * np.ones(3), rtol=1e-6)

@@ -1,0 +1,76 @@
+"""n-step target math vs hand-computed values (SURVEY.md §4.1)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.ops import huber, n_step_targets, td_errors
+
+
+def reference_n_step(r, d, q, n, gamma):
+    """Slow, obviously-correct scalar reference."""
+    T = len(r)
+    U = T - n
+    ys = []
+    for t in range(U):
+        acc, cont = 0.0, 1.0
+        for k in range(n):
+            acc += (gamma**k) * cont * r[t + k]
+            cont *= d[t + k]
+        acc += (gamma**n) * cont * q[t + n]
+        ys.append(acc)
+    return np.array(ys)
+
+
+@pytest.mark.parametrize("n", [1, 3, 5])
+def test_n_step_matches_scalar_reference(n):
+    rng = np.random.RandomState(0)
+    T = 12
+    r = rng.randn(T).astype(np.float32)
+    d = (rng.rand(T) > 0.2).astype(np.float32)
+    q = rng.randn(T).astype(np.float32)
+    got = n_step_targets(jnp.array(r), jnp.array(d), jnp.array(q), n=n, gamma=0.97)
+    want = reference_n_step(r, d, q, n, 0.97)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_n_step_no_termination_closed_form():
+    # Constant reward 1, no terminations, q == 0: y = sum_{k<n} gamma^k.
+    T, n, gamma = 10, 5, 0.9
+    y = n_step_targets(
+        jnp.ones(T), jnp.ones(T), jnp.zeros(T), n=n, gamma=gamma
+    )
+    want = sum(gamma**k for k in range(n))
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-6)
+
+
+def test_n_step_terminal_cuts_bootstrap_and_rewards():
+    # Termination at t=0 (d[0]=0): y_0 = r_0 only, regardless of q and later r.
+    T, n = 8, 5
+    r = np.arange(1.0, T + 1.0, dtype=np.float32)
+    d = np.ones(T, np.float32)
+    d[0] = 0.0
+    q = 100.0 * np.ones(T, np.float32)
+    y = n_step_targets(jnp.array(r), jnp.array(d), jnp.array(q), n=n, gamma=0.99)
+    np.testing.assert_allclose(np.asarray(y)[0], r[0], rtol=1e-6)
+
+
+def test_n_step_batched_shapes():
+    B, T, n = 4, 11, 5
+    r = jnp.ones((B, T))
+    y = n_step_targets(r, jnp.ones((B, T)), jnp.zeros((B, T)), n=n, gamma=0.99)
+    assert y.shape == (B, T - n)
+
+
+def test_n_step_rejects_short_sequences():
+    with pytest.raises(ValueError):
+        n_step_targets(jnp.ones(5), jnp.ones(5), jnp.ones(5), n=5, gamma=0.99)
+
+
+def test_td_errors_and_huber():
+    q = jnp.array([1.0, 2.0])
+    y = jnp.array([1.5, 0.0])
+    np.testing.assert_allclose(np.asarray(td_errors(q, y)), [0.5, -2.0])
+    # Huber: quadratic inside delta, linear outside.
+    np.testing.assert_allclose(float(huber(jnp.array(0.5))), 0.125)
+    np.testing.assert_allclose(float(huber(jnp.array(2.0))), 0.5 + 1.0)
