@@ -1,0 +1,111 @@
+"""Headline benchmark: learner steps/sec/chip (BASELINE.json `metric`).
+
+Measures the sustained rate of the full R2D2-DPG learner step — prioritized
+sample from the HBM arena, LSTM burn-in of all four nets, n-step targets,
+IS-weighted critic + actor updates, Polyak, Pallas priority write-back — at
+config-#3 (walker) shapes: batch 64, seq 20+20+5, obs 24, act 6, hidden 256.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` compares against ``BENCH_BASELINE.json`` (this repo's first
+recorded TPU number — the reference repo published no benchmark figures;
+see BASELINE.md provenance) or 1.0 if absent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from r2d2dpg_tpu.agents import AgentConfig, R2D2DPG
+    from r2d2dpg_tpu.models import ActorNet, CriticNet
+    from r2d2dpg_tpu.ops import sequence_priority
+    from r2d2dpg_tpu.replay import ReplayArena, SequenceBatch
+
+    # Config-#3 (walker_r2d2) learner shapes.
+    batch, obs_dim, act_dim, hidden = 64, 24, 6, 256
+    cfg = AgentConfig(burnin=20, unroll=20, n_step=5)
+    seq_len = cfg.seq_len
+    capacity = 100_000
+
+    actor = ActorNet(action_dim=act_dim, hidden=hidden, use_lstm=True)
+    critic = CriticNet(hidden=hidden, use_lstm=True)
+    agent = R2D2DPG(actor, critic, cfg)
+
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 8)
+    fill = 4096  # sequences resident for realistic sampling
+    seqs = SequenceBatch(
+        obs=jax.random.normal(ks[0], (fill, seq_len, obs_dim)),
+        action=jax.random.uniform(ks[1], (fill, seq_len, act_dim), minval=-1, maxval=1),
+        reward=jax.random.normal(ks[2], (fill, seq_len)),
+        discount=jnp.ones((fill, seq_len)),
+        reset=jnp.zeros((fill, seq_len)),
+        carries={
+            "actor": actor.initial_carry(fill),
+            "critic": critic.initial_carry(fill),
+        },
+    )
+    arena = ReplayArena(capacity, prioritized=True)
+    arena_state = arena.init_state(seqs)
+    arena_state = arena.add(
+        arena_state, seqs, jax.random.uniform(ks[3], (fill,)) + 0.5
+    )
+    train = agent.init(ks[4], seqs.obs[:batch, 0], seqs.action[:batch, 0])
+
+    def one_step(carry, key):
+        train, arena_state = carry
+        res = arena.sample(arena_state, key, batch)
+        w = jnp.ones((batch,))
+        train, prios, _ = agent.learner_step(train, res.batch, w)
+        arena_state = arena.update_priorities(arena_state, res.indices, prios)
+        return (train, arena_state), prios.mean()
+
+    @jax.jit
+    def run_chunk(train, arena_state, key):
+        keys = jax.random.split(key, CHUNK)
+        (train, arena_state), out = jax.lax.scan(
+            one_step, (train, arena_state), keys
+        )
+        return train, arena_state, out.mean()
+
+    CHUNK = 50
+    # Warm-up / compile.
+    train, arena_state, _ = run_chunk(train, arena_state, ks[5])
+    jax.block_until_ready(train.step)
+
+    n_chunks = 6
+    t0 = time.perf_counter()
+    for i in range(n_chunks):
+        train, arena_state, out = run_chunk(
+            train, arena_state, jax.random.fold_in(ks[6], i)
+        )
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    steps_per_sec = n_chunks * CHUNK / dt
+
+    baseline = None
+    base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            baseline = json.load(f).get("value")
+    vs = steps_per_sec / baseline if baseline else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "learner_steps_per_sec_per_chip",
+                "value": round(steps_per_sec, 2),
+                "unit": "steps/s",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

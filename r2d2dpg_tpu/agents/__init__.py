@@ -1,0 +1,5 @@
+"""Agents (SURVEY.md §2.4): the R2D2-DPG learner as pure jittable functions."""
+
+from r2d2dpg_tpu.agents.ddpg import AgentConfig, R2D2DPG, TrainState
+
+__all__ = ["AgentConfig", "R2D2DPG", "TrainState"]

@@ -1,0 +1,334 @@
+"""R2D2-DPG learner: burn-in + n-step DDPG update as one jittable function.
+
+Reference parity: SURVEY.md §2.4 / §3.3 — the reference learner's hot loop is
+  sample -> host->device -> no-grad LSTM burn-in (all 4 nets) -> n-step
+  targets -> IS-weighted critic Huber loss -> actor loss -Q(s, mu(s)) ->
+  Adam steps -> Polyak soft target update -> priority write-back.
+Here the whole pipeline is a single pure function (`learner_step`) traced
+once under jit (BASELINE north star: "the LSTM actor-critic burn-in+unroll
+and n-step TD update become a single jit-compiled XLA graph") — there is no
+host->device boundary because the batch is gathered from the HBM arena
+in-graph.
+
+Algorithmic details the build reproduces [ALGO]:
+- burn-in from *stored* recurrent state, no gradient through the burn-in
+  (carries are stop_gradient'ed before the training unroll);
+- critic target ``y = sum gamma^k r + gamma^n Q_tgt(s', mu_tgt(s'))``;
+- actor loss ``-Q(s, mu(s))`` through the (frozen) online critic;
+- sequence priority ``eta*max|td| + (1-eta)*mean|td|`` written back;
+- soft target updates each step.
+
+Distributed (SURVEY §2.8): ``axis_name`` switches on gradient ``pmean`` over
+the device mesh — under ``shard_map`` each device computes grads on its local
+shard of the batch and syncs over ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from r2d2dpg_tpu.models.actor_critic import ActorNet, Carry, CriticNet, unroll
+from r2d2dpg_tpu.ops import (
+    huber,
+    n_step_targets,
+    polyak_update,
+    sequence_priority,
+    td_errors,
+)
+from r2d2dpg_tpu.replay.arena import SequenceBatch
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TrainState:
+    """All learner-owned mutable state (a pytree; device-resident)."""
+
+    actor_params: Any
+    critic_params: Any
+    target_actor_params: Any
+    target_critic_params: Any
+    actor_opt_state: Any
+    critic_opt_state: Any
+    step: jnp.ndarray
+
+
+@dataclasses.dataclass(frozen=True)
+class AgentConfig:
+    """Static hyperparameters (SURVEY §2.5 'Hyperparameters' row)."""
+
+    burnin: int = 20
+    unroll: int = 20
+    n_step: int = 5
+    gamma: float = 0.99
+    tau: float = 5e-3
+    eta: float = 0.9
+    actor_lr: float = 1e-4
+    critic_lr: float = 1e-3
+    use_huber: bool = True
+    grad_clip: Optional[float] = 40.0
+    axis_name: Optional[str] = None  # mesh axis for gradient sync (SPMD)
+
+    @property
+    def seq_len(self) -> int:
+        """Stored sequence length: burn-in + unroll + n-step bootstrap tail."""
+        return self.burnin + self.unroll + self.n_step
+
+
+def _tm(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.swapaxes(x, 0, 1)
+
+
+class R2D2DPG:
+    """Agent: networks + optimizers + the learner step (pure functions)."""
+
+    def __init__(self, actor: ActorNet, critic: CriticNet, config: AgentConfig):
+        self.actor = actor
+        self.critic = critic
+        self.config = config
+
+        def tx(lr: float) -> optax.GradientTransformation:
+            if config.grad_clip is not None:
+                return optax.chain(
+                    optax.clip_by_global_norm(config.grad_clip), optax.adam(lr)
+                )
+            return optax.adam(lr)
+
+        self.actor_tx = tx(config.actor_lr)
+        self.critic_tx = tx(config.critic_lr)
+
+    # ------------------------------------------------------------------ init
+    def init(
+        self, key: jax.Array, example_obs: jnp.ndarray, example_action: jnp.ndarray
+    ) -> TrainState:
+        """Initialize params/opt-states from example [B, ...] obs/action."""
+        ka, kc = jax.random.split(key)
+        b = example_obs.shape[0]
+        reset = jnp.zeros((b,))
+        actor_params = self.actor.init(
+            ka, example_obs, self.actor.initial_carry(b), reset
+        )
+        critic_params = self.critic.init(
+            kc, example_obs, example_action, self.critic.initial_carry(b), reset
+        )
+        # Targets start as *copies* — aliased buffers would break donation
+        # of the TrainState pytree in the trainer's jitted phases.
+        copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)  # noqa: E731
+        return TrainState(
+            actor_params=actor_params,
+            critic_params=critic_params,
+            target_actor_params=copy(actor_params),
+            target_critic_params=copy(critic_params),
+            actor_opt_state=self.actor_tx.init(actor_params),
+            critic_opt_state=self.critic_tx.init(critic_params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    # --------------------------------------------------------------- unrolls
+    def _unroll_actor(self, params, carry, obs_tm, reset_tm):
+        return unroll(
+            lambda c, o, r: self.actor.apply(params, o, c, r), carry, obs_tm, reset_tm
+        )
+
+    def _unroll_critic(self, params, carry, obs_tm, act_tm, reset_tm):
+        return unroll(
+            lambda c, o, a, r: self.critic.apply(params, o, a, c, r),
+            carry,
+            obs_tm,
+            act_tm,
+            reset_tm,
+        )
+
+    def _burn_in(
+        self, state: TrainState, batch: SequenceBatch
+    ) -> Tuple[Carry, Carry, Carry, Carry]:
+        """Warm all four nets' carries over the burn-in prefix, no gradient.
+
+        SURVEY §3.3 hot loop: `no_grad: (h,c) = burn_in(seq[:B_len])` — online
+        and target nets each burn in from the *stored* initial state.
+        """
+        cfg = self.config
+        ca0, cc0 = batch.carries["actor"], batch.carries["critic"]
+        if cfg.burnin == 0 or not (self.actor.use_lstm or self.critic.use_lstm):
+            return ca0, ca0, cc0, cc0
+        obs_b = _tm(batch.obs[:, : cfg.burnin])
+        act_b = _tm(batch.action[:, : cfg.burnin])
+        reset_b = _tm(batch.reset[:, : cfg.burnin])
+        ca_on = ca_tg = ca0
+        cc_on = cc_tg = cc0
+        if self.actor.use_lstm:
+            _, ca_on = self._unroll_actor(state.actor_params, ca0, obs_b, reset_b)
+            _, ca_tg = self._unroll_actor(
+                state.target_actor_params, ca0, obs_b, reset_b
+            )
+        if self.critic.use_lstm:
+            _, cc_on = self._unroll_critic(
+                state.critic_params, cc0, obs_b, act_b, reset_b
+            )
+            _, cc_tg = self._unroll_critic(
+                state.target_critic_params, cc0, obs_b, act_b, reset_b
+            )
+        sg = lax.stop_gradient
+        return sg(ca_on), sg(ca_tg), sg(cc_on), sg(cc_tg)
+
+    # ---------------------------------------------------------- learner step
+    def learner_step(
+        self,
+        state: TrainState,
+        batch: SequenceBatch,
+        is_weights: jnp.ndarray,
+    ) -> Tuple[TrainState, jnp.ndarray, Dict[str, jnp.ndarray]]:
+        """One optimization step on a batch of sequences.
+
+        Args:
+          state: current TrainState.
+          batch: ``[B, L, ...]`` sequences, ``L == config.seq_len``.
+          is_weights: ``[B]`` importance-sampling weights (ones when uniform).
+
+        Returns:
+          (new_state, new_priorities ``[B]``, metrics).
+        """
+        cfg = self.config
+        U = cfg.unroll
+
+        ca_on, ca_tg, cc_on, cc_tg = self._burn_in(state, batch)
+
+        # Training window: [burnin, burnin+U+n) — time-major for the scans.
+        w = slice(cfg.burnin, cfg.seq_len)
+        obs_w = _tm(batch.obs[:, w])
+        act_w = _tm(batch.action[:, w])
+        reset_w = _tm(batch.reset[:, w])
+        rew_w = batch.reward[:, w]  # batch-major [B, U+n]
+        disc_w = batch.discount[:, w]
+
+        # --- n-step targets through the target nets (no gradient).
+        a_tg_tm, _ = self._unroll_actor(
+            state.target_actor_params, ca_tg, obs_w, reset_w
+        )
+        q_tg_tm, _ = self._unroll_critic(
+            state.target_critic_params, cc_tg, obs_w, a_tg_tm, reset_w
+        )
+        y = lax.stop_gradient(
+            n_step_targets(
+                rew_w,
+                disc_w,
+                batch.reset[:, w],
+                _tm(q_tg_tm),
+                n=cfg.n_step,
+                gamma=cfg.gamma,
+            )
+        )  # [B, U]
+
+        # Online unrolls only need the U training steps (the n-step tail is
+        # exclusively for target bootstraps) — saves ~n/(U+n) hot-loop LSTM
+        # forward+backward compute.
+        obs_u, act_u, reset_u = obs_w[:U], act_w[:U], reset_w[:U]
+
+        # --- critic update (IS-weighted; SURVEY §2.4 "weighted by IS weights").
+        def critic_loss_fn(critic_params):
+            q_tm, _ = self._unroll_critic(critic_params, cc_on, obs_u, act_u, reset_u)
+            q = _tm(q_tm)  # [B, U]
+            td = td_errors(q, y)
+            per_step = huber(td) if cfg.use_huber else 0.5 * td**2
+            loss = (is_weights[:, None] * per_step).mean()
+            return loss, (td, q)
+
+        (critic_loss, (td, q_pred)), critic_grads = jax.value_and_grad(
+            critic_loss_fn, has_aux=True
+        )(state.critic_params)
+
+        # --- actor update: -Q(s, mu(s)) through the frozen online critic.
+        def actor_loss_fn(actor_params):
+            a_tm, _ = self._unroll_actor(actor_params, ca_on, obs_u, reset_u)
+            q_pi_tm, _ = self._unroll_critic(
+                state.critic_params, cc_on, obs_u, a_tm, reset_u
+            )
+            return -q_pi_tm.mean()
+
+        actor_loss, actor_grads = jax.value_and_grad(actor_loss_fn)(
+            state.actor_params
+        )
+
+        # --- gradient sync over the mesh (SURVEY §2.8: psum over ICI).
+        if cfg.axis_name is not None:
+            critic_grads = lax.pmean(critic_grads, cfg.axis_name)
+            actor_grads = lax.pmean(actor_grads, cfg.axis_name)
+
+        critic_updates, critic_opt_state = self.critic_tx.update(
+            critic_grads, state.critic_opt_state, state.critic_params
+        )
+        critic_params = optax.apply_updates(state.critic_params, critic_updates)
+        actor_updates, actor_opt_state = self.actor_tx.update(
+            actor_grads, state.actor_opt_state, state.actor_params
+        )
+        actor_params = optax.apply_updates(state.actor_params, actor_updates)
+
+        new_state = TrainState(
+            actor_params=actor_params,
+            critic_params=critic_params,
+            target_actor_params=polyak_update(
+                actor_params, state.target_actor_params, cfg.tau
+            ),
+            target_critic_params=polyak_update(
+                critic_params, state.target_critic_params, cfg.tau
+            ),
+            actor_opt_state=actor_opt_state,
+            critic_opt_state=critic_opt_state,
+            step=state.step + 1,
+        )
+        priorities = sequence_priority(td, eta=cfg.eta)
+        metrics = {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "q_mean": q_pred.mean(),
+            "td_abs_mean": jnp.abs(td).mean(),
+            "target_mean": y.mean(),
+        }
+        return new_state, priorities, metrics
+
+    # ------------------------------------------------------- initial priority
+    def initial_priority(
+        self, state: TrainState, batch: SequenceBatch
+    ) -> jnp.ndarray:
+        """TD-error priority for fresh sequences at collection time.
+
+        SURVEY §2.2 "Initial priority" [ALGO, Ape-X §3]: actors compute the
+        TD error locally so sequences enter replay with a meaningful
+        priority.  In the Anakin layout this runs on-device right after the
+        actor phase, with the current online/target nets.
+        """
+        cfg = self.config
+        ca_on, ca_tg, cc_on, cc_tg = self._burn_in(state, batch)
+        w = slice(cfg.burnin, cfg.seq_len)
+        obs_w = _tm(batch.obs[:, w])
+        act_w = _tm(batch.action[:, w])
+        reset_w = _tm(batch.reset[:, w])
+
+        a_tg_tm, _ = self._unroll_actor(
+            state.target_actor_params, ca_tg, obs_w, reset_w
+        )
+        q_tg_tm, _ = self._unroll_critic(
+            state.target_critic_params, cc_tg, obs_w, a_tg_tm, reset_w
+        )
+        y = n_step_targets(
+            batch.reward[:, w],
+            batch.discount[:, w],
+            batch.reset[:, w],
+            _tm(q_tg_tm),
+            n=cfg.n_step,
+            gamma=cfg.gamma,
+        )
+        q_tm, _ = self._unroll_critic(
+            state.critic_params,
+            cc_on,
+            obs_w[: cfg.unroll],
+            act_w[: cfg.unroll],
+            reset_w[: cfg.unroll],
+        )
+        td = td_errors(_tm(q_tm), y)
+        return sequence_priority(td, eta=cfg.eta)

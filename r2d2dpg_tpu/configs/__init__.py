@@ -1,0 +1,223 @@
+"""The five BASELINE capability configs (BASELINE.json `configs`).
+
+Reference parity: SURVEY.md §2.5 — the reference keeps hyperparameters as
+constants in ``main.py``; here each BASELINE config is a named experiment
+(SURVEY §5.6: "the five named configs become configs/*").
+
+| # | name              | BASELINE.json line                                          |
+|---|-------------------|-------------------------------------------------------------|
+| 1 | pendulum_ddpg     | Pendulum-v1, 1 actor, feedforward DDPG, uniform replay      |
+| 2 | pendulum_r2d2     | Pendulum-v1, 4 actors, LSTM + burn-in, prioritized replay   |
+| 3 | walker_r2d2       | DM-Control Walker-walk, 64 actors, seq-len 40, n-step 5     |
+| 4 | humanoid_r2d2     | DM-Control Humanoid-run, 256 actors, seq-len 80, soft-update|
+| 5 | cheetah_pixels    | DM-Control Cheetah-run from pixels, CNN+LSTM, 256 actors    |
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict
+
+from r2d2dpg_tpu.agents.ddpg import AgentConfig, R2D2DPG
+from r2d2dpg_tpu.envs.core import Environment
+from r2d2dpg_tpu.models import ActorNet, CriticNet
+from r2d2dpg_tpu.training.trainer import Trainer, TrainerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    """One runnable experiment: env factory + net shape + agent + trainer."""
+
+    name: str
+    env_factory: Callable[[], Environment]
+    agent: AgentConfig
+    trainer: TrainerConfig
+    use_lstm: bool = True
+    pixels: bool = False
+    hidden: int = 256
+
+    def build(self) -> Trainer:
+        env = self.env_factory()
+        actor = ActorNet(
+            action_dim=env.spec.action_dim,
+            hidden=self.hidden,
+            use_lstm=self.use_lstm,
+            pixels=self.pixels,
+        )
+        critic = CriticNet(
+            hidden=self.hidden, use_lstm=self.use_lstm, pixels=self.pixels
+        )
+        agent = R2D2DPG(actor, critic, self.agent)
+        return Trainer(env, agent, self.trainer)
+
+
+def _pendulum():
+    from r2d2dpg_tpu.envs.pendulum import Pendulum
+
+    return Pendulum()
+
+
+def _dmc(domain: str, task: str, pixels: bool = False):
+    def factory():
+        from r2d2dpg_tpu.envs.dmc_host import DMCHostEnv
+
+        return DMCHostEnv(domain, task, pixels=pixels)
+
+    return factory
+
+
+# 1: classic DDPG smoke slice (SURVEY §4.3's golden-learning config).
+PENDULUM_DDPG = ExperimentConfig(
+    name="pendulum_ddpg",
+    env_factory=_pendulum,
+    use_lstm=False,
+    hidden=256,
+    agent=AgentConfig(
+        burnin=0,
+        unroll=1,
+        n_step=1,
+        gamma=0.99,
+        tau=5e-3,
+        actor_lr=1e-3,
+        critic_lr=1e-3,
+        use_huber=False,
+    ),
+    trainer=TrainerConfig(
+        num_envs=1,
+        stride=1,
+        learner_steps=1,
+        batch_size=128,
+        capacity=100_000,
+        prioritized=False,
+        min_replay=1_000,
+        sigma_max=0.15,
+        ladder_kind="constant",
+    ),
+)
+
+# 2: the full R2D2 recurrent-replay recipe on the toy env.
+PENDULUM_R2D2 = ExperimentConfig(
+    name="pendulum_r2d2",
+    env_factory=_pendulum,
+    use_lstm=True,
+    hidden=128,
+    agent=AgentConfig(
+        burnin=10,
+        unroll=20,
+        n_step=5,
+        gamma=0.99,
+        tau=5e-3,
+        actor_lr=5e-4,
+        critic_lr=1e-3,
+    ),
+    trainer=TrainerConfig(
+        num_envs=4,
+        stride=10,
+        learner_steps=1,
+        batch_size=64,
+        capacity=50_000,
+        prioritized=True,
+        min_replay=200,
+        sigma_max=0.3,
+        ladder_alpha=3.0,
+    ),
+)
+
+# 3: the north-star metric config (walker-walk @ 30 min).
+WALKER_R2D2 = ExperimentConfig(
+    name="walker_r2d2",
+    env_factory=_dmc("walker", "walk"),
+    use_lstm=True,
+    agent=AgentConfig(
+        burnin=20,
+        unroll=20,
+        n_step=5,
+        gamma=0.99,
+        tau=5e-3,
+        actor_lr=1e-4,
+        critic_lr=1e-3,
+    ),
+    trainer=TrainerConfig(
+        num_envs=64,
+        stride=20,
+        learner_steps=4,
+        batch_size=64,
+        capacity=100_000,
+        prioritized=True,
+        min_replay=2_000,
+        sigma_max=0.4,
+        ladder_alpha=7.0,
+    ),
+)
+
+# 4: long sequences (seq-len 80) at 256 actors.
+HUMANOID_R2D2 = ExperimentConfig(
+    name="humanoid_r2d2",
+    env_factory=_dmc("humanoid", "run"),
+    use_lstm=True,
+    agent=AgentConfig(
+        burnin=40,
+        unroll=40,
+        n_step=5,
+        gamma=0.99,
+        tau=5e-3,
+        actor_lr=1e-4,
+        critic_lr=1e-3,
+    ),
+    trainer=TrainerConfig(
+        num_envs=256,
+        stride=40,
+        learner_steps=4,
+        batch_size=64,
+        capacity=50_000,
+        prioritized=True,
+        min_replay=2_000,
+        sigma_max=0.4,
+        ladder_alpha=7.0,
+    ),
+)
+
+# 5: from-pixels (CNN+LSTM encoder).
+CHEETAH_PIXELS = ExperimentConfig(
+    name="cheetah_pixels",
+    env_factory=_dmc("cheetah", "run", pixels=True),
+    use_lstm=True,
+    pixels=True,
+    agent=AgentConfig(
+        burnin=20,
+        unroll=20,
+        n_step=5,
+        gamma=0.99,
+        tau=5e-3,
+        actor_lr=1e-4,
+        critic_lr=5e-4,
+    ),
+    trainer=TrainerConfig(
+        num_envs=256,
+        stride=20,
+        learner_steps=2,
+        batch_size=32,
+        capacity=8_000,
+        prioritized=True,
+        min_replay=1_000,
+        sigma_max=0.4,
+        ladder_alpha=7.0,
+    ),
+)
+
+CONFIGS: Dict[str, ExperimentConfig] = {
+    c.name: c
+    for c in (
+        PENDULUM_DDPG,
+        PENDULUM_R2D2,
+        WALKER_R2D2,
+        HUMANOID_R2D2,
+        CHEETAH_PIXELS,
+    )
+}
+
+
+def get_config(name: str) -> ExperimentConfig:
+    if name not in CONFIGS:
+        raise KeyError(f"unknown config {name!r}; have {sorted(CONFIGS)}")
+    return CONFIGS[name]

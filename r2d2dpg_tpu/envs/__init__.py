@@ -1,6 +1,7 @@
 """Environments (SURVEY.md §2.6): pure-JAX on-device + host-callback pools."""
 
 from r2d2dpg_tpu.envs.core import Environment, EnvSpec, EnvState, TimeStep
+from r2d2dpg_tpu.envs.dmc_host import DMCHostEnv
 from r2d2dpg_tpu.envs.pendulum import Pendulum
 
-__all__ = ["Environment", "EnvSpec", "EnvState", "Pendulum", "TimeStep"]
+__all__ = ["DMCHostEnv", "Environment", "EnvSpec", "EnvState", "Pendulum", "TimeStep"]

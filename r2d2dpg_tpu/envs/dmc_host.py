@@ -1,0 +1,245 @@
+"""DM-Control environments as a host-callback pool (SURVEY.md §7 step 5b).
+
+No MJX ships in this image, so MuJoCo physics cannot run on-device; the
+TPU-native compromise keeps *everything else* in the jitted program and
+crosses to host only for the physics step: a pool of ``dm_control`` envs
+steps in a thread pool (MuJoCo releases the GIL during ``mj_step``), exposed
+to JAX through an **ordered ``io_callback``** so the whole actor phase stays
+inside ``lax.scan`` (SURVEY §3.2's hot loop, with the env.step row replaced
+by one batched host call).
+
+This is the moral equivalent of the reference's N actor processes stepping
+gym/dm_control on CPU (SURVEY §2.3) — except the policy forward, noise,
+sequence assembly, replay and learner never leave the device, and the host
+boundary moves exactly one obs/action batch per step.
+
+Contract notes:
+- Batched: implements the ``batched = True`` env API (``reset(key, n)``,
+  ``step(state, actions, key)`` over ``[E, ...]``); the trainer skips vmap.
+- Ordering: the callback is ``ordered=True`` — host env state is mutable, so
+  calls must execute in program order.  This is incompatible with vmap /
+  shard_map; the SPMD trainer rejects batched host envs (multi-chip scaling
+  of host-backed envs needs one pool per host — a later milestone, tracked
+  in docs/PARITY.md).
+- Auto-reset: on ``dm_ts.last()`` the pool resets that env and returns the
+  fresh obs with ``reset=1``; ``discount`` keeps dm_control's semantics
+  (0 only on true termination, 1 on time-limit truncation), which is
+  exactly what ``ops.returns.n_step_targets`` expects.
+- Pixels (BASELINE config #5): 64x64x3 uint8 via MuJoCo's EGL headless
+  renderer (``MUJOCO_GL=egl`` — set automatically; osmesa/glfw are broken in
+  this image).  Physics steps run in threads; renders run serially (EGL
+  contexts are not thread-safe).
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from r2d2dpg_tpu.envs.core import EnvSpec, TimeStep
+
+_PIXEL_HW = 64
+
+
+def _load_dmc(domain: str, task: str, seed: int):
+    from dm_control import suite
+
+    return suite.load(domain, task, task_kwargs={"random": seed})
+
+
+def _flatten_obs(obs_dict) -> np.ndarray:
+    parts = [np.asarray(v, np.float32).reshape(-1) for v in obs_dict.values()]
+    return np.concatenate(parts) if parts else np.zeros((0,), np.float32)
+
+
+class _HostPool:
+    """The host-side fleet: E dm_control envs + a thread pool."""
+
+    def __init__(self, domain: str, task: str, pixels: bool, camera_id: int):
+        self.domain, self.task = domain, task
+        self.pixels = pixels
+        self.camera_id = camera_id
+        self.envs: list = []
+        self.executor: Optional[ThreadPoolExecutor] = None
+        # EGL contexts are bound to the thread that created them, and XLA may
+        # fire io_callbacks from different threads across steps — so every
+        # render runs on one dedicated thread for the pool's lifetime.
+        self.render_thread: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(max_workers=1) if pixels else None
+        )
+
+    def ensure(self, seeds: np.ndarray):
+        """Create or re-seed the fleet to match the per-env ``seeds``."""
+        num_envs = len(seeds)
+        if len(self.envs) != num_envs:
+            self.envs = [
+                _load_dmc(self.domain, self.task, int(s)) for s in seeds
+            ]
+            self.executor = ThreadPoolExecutor(
+                max_workers=min(32, max(1, num_envs))
+            )
+            if self.pixels:
+                # Free EGL contexts from the thread they are current on;
+                # dm_control's own atexit hook would EGL_BAD_ACCESS otherwise.
+                atexit.register(self._free_render_contexts)
+        else:
+            # Explicit re-reset: honor the new seeds on the existing fleet.
+            for env, s in zip(self.envs, seeds):
+                env.task._random = np.random.RandomState(int(s))
+
+    def _free_render_contexts(self):
+        def _free():
+            for env in self.envs:
+                try:
+                    env.physics.free()
+                except Exception:
+                    pass
+
+        try:
+            self.render_thread.submit(_free).result(timeout=10)
+        except Exception:
+            pass
+
+    def _obs_of(self, env, dm_ts) -> np.ndarray:
+        if self.pixels:
+            return self.render_thread.submit(
+                env.physics.render,
+                height=_PIXEL_HW,
+                width=_PIXEL_HW,
+                camera_id=self.camera_id,
+            ).result()
+        return _flatten_obs(dm_ts.observation)
+
+    def reset_all(self, seeds: np.ndarray):
+        self.ensure(seeds)
+        dm_steps = [env.reset() for env in self.envs]
+        obs = np.stack([self._obs_of(e, ts) for e, ts in zip(self.envs, dm_steps)])
+        e = len(self.envs)
+        return (
+            obs,
+            np.zeros((e,), np.float32),
+            np.ones((e,), np.float32),
+            np.ones((e,), np.float32),
+        )
+
+    def step_all(self, actions: np.ndarray):
+        def step_one(i):
+            env = self.envs[i]
+            dm_ts = env.step(actions[i])
+            if dm_ts.last():
+                reward = np.float32(dm_ts.reward or 0.0)
+                discount = np.float32(
+                    1.0 if dm_ts.discount is None else dm_ts.discount
+                )
+                fresh = env.reset()
+                return fresh, reward, discount, np.float32(1.0)
+            return (
+                dm_ts,
+                np.float32(dm_ts.reward or 0.0),
+                np.float32(1.0 if dm_ts.discount is None else dm_ts.discount),
+                np.float32(0.0),
+            )
+
+        results = list(self.executor.map(step_one, range(len(self.envs))))
+        # Renders (pixels) happen here, serially, on the callback thread.
+        obs = np.stack(
+            [self._obs_of(e, r[0]) for e, r in zip(self.envs, results)]
+        )
+        reward = np.stack([r[1] for r in results])
+        discount = np.stack([r[2] for r in results])
+        reset = np.stack([r[3] for r in results])
+        return obs, reward, discount, reset
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DMCState:
+    """Device-side token; the host pool owns the real state.  The token is
+    threaded through every callback to give XLA a data dependency chain."""
+
+    token: jnp.ndarray
+
+
+class DMCHostEnv:
+    """Batched functional facade over a host dm_control pool."""
+
+    batched = True
+
+    # action/obs specs per (domain, task) we ship configs for; measured once
+    # at construction from a probe env.
+    def __init__(
+        self,
+        domain: str,
+        task: str,
+        *,
+        pixels: bool = False,
+        camera_id: int = 0,
+    ):
+        if pixels:
+            os.environ.setdefault("MUJOCO_GL", "egl")
+        probe = _load_dmc(domain, task, 0)
+        action_spec = probe.action_spec()
+        self._act_min = np.asarray(action_spec.minimum, np.float32)
+        self._act_max = np.asarray(action_spec.maximum, np.float32)
+        ts0 = probe.reset()
+        if pixels:
+            obs_shape: Tuple[int, ...] = (_PIXEL_HW, _PIXEL_HW, 3)
+            self._obs_dtype = jnp.uint8
+        else:
+            obs_shape = _flatten_obs(ts0.observation).shape
+            self._obs_dtype = jnp.float32
+        limit = getattr(probe, "_step_limit", 1000)
+        self.spec = EnvSpec(
+            name=f"{domain}-{task}" + ("-pixels" if pixels else ""),
+            obs_shape=obs_shape,
+            action_dim=int(np.prod(action_spec.shape)),
+            action_min=float(self._act_min.min()),
+            action_max=float(self._act_max.max()),
+            episode_length=int(limit) if np.isfinite(limit) else 1000,
+            pixels=pixels,
+        )
+        probe.close()
+        self._pool = _HostPool(domain, task, pixels, camera_id)
+
+    # ------------------------------------------------------------- callbacks
+    def _result_shapes(self, e: int):
+        return (
+            jax.ShapeDtypeStruct((e,) + self.spec.obs_shape, self._obs_dtype),
+            jax.ShapeDtypeStruct((e,), jnp.float32),
+            jax.ShapeDtypeStruct((e,), jnp.float32),
+            jax.ShapeDtypeStruct((e,), jnp.float32),
+        )
+
+    def reset(self, key: jax.Array, num_envs: int) -> Tuple[DMCState, TimeStep]:
+        seeds = jax.random.randint(key, (num_envs,), 0, 2**31 - 1)
+        obs, reward, discount, reset = io_callback(
+            self._pool.reset_all,
+            self._result_shapes(num_envs),
+            seeds,
+            ordered=True,
+        )
+        ts = TimeStep(obs=obs, reward=reward, discount=discount, reset=reset)
+        return DMCState(token=jnp.zeros((), jnp.int32)), ts
+
+    def step(
+        self, state: DMCState, actions: jnp.ndarray, key: jax.Array
+    ) -> Tuple[DMCState, TimeStep]:
+        del key  # host envs own their randomness (seeded at creation)
+        lo, hi = jnp.asarray(self._act_min), jnp.asarray(self._act_max)
+        scaled = lo + (jnp.clip(actions, -1.0, 1.0) + 1.0) * 0.5 * (hi - lo)
+        # The token rides along so successive steps form a dependency chain.
+        scaled = scaled + 0.0 * state.token.astype(scaled.dtype)
+        e = actions.shape[0]
+        obs, reward, discount, reset = io_callback(
+            self._pool.step_all, self._result_shapes(e), scaled, ordered=True
+        )
+        ts = TimeStep(obs=obs, reward=reward, discount=discount, reset=reset)
+        return DMCState(token=state.token + 1), ts

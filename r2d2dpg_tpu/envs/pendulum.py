@@ -5,7 +5,9 @@ JAX keeps the whole loop one jit graph from day one (SURVEY.md §7 step 3).
 Dynamics match gymnasium's ``PendulumEnv`` (g=10, m=1, l=1, dt=0.05, torque
 in [-2, 2], reward = -(theta^2 + 0.1*thdot^2 + 0.001*u^2), 200-step episodes,
 time-limit truncation only — never termination, so ``discount`` stays 1 and
-bootstrapping through the limit is correct).
+the step carrying ``reset=1`` marks a truncation boundary: the learner's
+n-step targets shorten their horizon there and bootstrap at the last stored
+pre-limit state (see ``ops.returns.n_step_targets``).
 
 Envs take canonical actions in [-1, 1] (the tanh policy range) and rescale
 internally; ``spec`` records the true torque range.

@@ -43,11 +43,17 @@ Carry = Any
 
 
 def lstm_initial_carry(batch_size: int, hidden: int, use_lstm: bool) -> Carry:
-    """Fresh carry for a net: flax's (c, h) zeros for LSTM, () for feedforward."""
+    """Fresh carry for a net: flax's (c, h) zeros for LSTM, () for feedforward.
+
+    (c, h) are distinct buffers — aliased leaves break argument donation in
+    the trainer's jitted phases.
+    """
     if not use_lstm:
         return ()
-    zeros = jnp.zeros((batch_size, hidden), jnp.float32)
-    return (zeros, zeros)
+    return (
+        jnp.zeros((batch_size, hidden), jnp.float32),
+        jnp.zeros((batch_size, hidden), jnp.float32),
+    )
 
 
 def zeros_where_reset(carry: Carry, reset: jnp.ndarray) -> Carry:

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels (SURVEY.md §2.9 build-side native components)."""
+
+from r2d2dpg_tpu.ops.pallas.scatter import priority_scatter
+
+__all__ = ["priority_scatter"]

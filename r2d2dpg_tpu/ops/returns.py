@@ -7,16 +7,29 @@ formula is forced by the DDPG/R2D2 algorithm, tag [ALGO]).
 
 Conventions
 -----------
-A stored sequence step ``t`` holds ``(obs_t, a_t, r_t, d_t)`` where ``r_t`` is
-the reward received after executing ``a_t`` in ``obs_t`` and ``d_t`` in
-``{0., 1.}`` is the *continuation* flag: 0 if the episode terminated at the
-transition ``t -> t+1``.  A sequence of length ``burnin + unroll + n`` gives
-every step of the training window ``[burnin, burnin+unroll)`` a full n-step
-target; the trailing ``n`` steps contribute only rewards and the bootstrap.
+A stored sequence step ``t`` holds ``(obs_t, a_t, r_t, d_t, reset_t)`` where
+``r_t`` is the reward received after executing ``a_t`` in ``obs_t``,
+``d_t`` in ``{0., 1.}`` is the *continuation* flag (0 if the episode
+*terminated* at the transition ``t -> t+1``), and ``reset_t`` is 1 when
+``obs_t`` begins a new episode (the env auto-reset between ``t-1`` and
+``t``).  A sequence of length ``burnin + unroll + n`` gives every step of
+the training window ``[burnin, burnin+unroll)`` a full n-step target; the
+trailing ``n`` steps contribute only rewards and the bootstrap.
 
-Everything here is shape-static and jit/vmap/scan friendly: the n-step loop is
-a Python loop over the *static* ``n`` (unrolled at trace time onto the MXU-fed
-fused elementwise path), not a dynamic loop.
+Episode boundaries inside the n-step horizon:
+
+- **Termination** (``d_{t+k} = 0``): reward ``r_{t+k}`` counts, everything
+  after is cut by the discount product — the classic treatment.
+- **Truncation** (``reset_{t+k+1} = 1`` with ``d_{t+k} = 1``, e.g. a time
+  limit): the successor state was discarded by the auto-reset, so the
+  horizon is *shortened* to bootstrap at the last stored same-episode state
+  ``q_{t+k}`` and the boundary-crossing reward ``r_{t+k}`` is dropped (its
+  value is already inside ``q_{t+k}``'s estimate).  This keeps targets
+  unbiased instead of leaking the next episode's rewards/values in.
+
+Everything here is shape-static and jit/vmap/scan friendly: the n-step loop
+is a Python loop over the *static* ``n`` (unrolled at trace time onto fused
+VPU elementwise passes), not a dynamic loop.
 """
 
 from __future__ import annotations
@@ -28,29 +41,28 @@ from jax import lax
 def n_step_targets(
     rewards: jnp.ndarray,
     discounts: jnp.ndarray,
+    resets: jnp.ndarray,
     bootstrap_q: jnp.ndarray,
     *,
     n: int,
     gamma: float,
 ) -> jnp.ndarray:
-    """Compute n-step TD targets along the trailing time axis.
+    """Boundary-aware n-step TD targets along the trailing time axis.
 
     Args:
       rewards: ``[..., U + n]`` per-step rewards ``r_t``.
       discounts: ``[..., U + n]`` continuation flags ``d_t`` (0 at terminal
-        transitions, else 1; any value in [0, 1] works, e.g. absorbing-state
-        discounts).
+        transitions; values in [0, 1] allowed).
+      resets: ``[..., U + n]`` episode-start flags (1 where ``obs_t`` begins
+        a fresh episode).
       bootstrap_q: ``[..., U + n]`` per-step bootstrap values
-        ``q_t = Q_tgt(s_t, mu_tgt(s_t))`` aligned with ``rewards`` — the
-        target at window position ``t`` bootstraps from ``bootstrap_q[t+n]``.
-      n: number of reward steps (static).
+        ``q_t = Q_tgt(s_t, mu_tgt(s_t))`` aligned with ``rewards``.
+      n: max number of reward steps (static).
       gamma: discount factor.
 
     Returns:
-      ``[..., U]`` targets ``y_t`` for the first ``U = T - n`` positions:
-
-        y_t = sum_{k=0}^{n-1} gamma^k (prod_{j<k} d_{t+j}) r_{t+k}
-              + gamma^n (prod_{j<n} d_{t+j}) q_{t+n}
+      ``[..., U]`` targets for the first ``U = T - n`` positions, with the
+      horizon shortened at truncation boundaries as described above.
     """
     T = rewards.shape[-1]
     U = T - n
@@ -60,13 +72,29 @@ def n_step_targets(
     def tslice(x, k):
         return lax.slice_in_dim(x, k, k + U, axis=-1)
 
-    cont = jnp.ones_like(tslice(rewards, 0))
-    acc = jnp.zeros_like(cont)
+    acc = jnp.zeros_like(tslice(rewards, 0))
+    cont = jnp.ones_like(acc)  # discount product (termination cut)
+    live = jnp.ones_like(acc)  # 1 until any episode boundary is crossed
+    y = tslice(bootstrap_q, 0)  # horizon-0 fallback (immediate truncation)
     for k in range(n):
-        acc = acc + (gamma**k) * cont * tslice(rewards, k)
-        cont = cont * tslice(discounts, k)
-    acc = acc + (gamma**n) * cont * tslice(bootstrap_q, n)
-    return acc
+        d_k = tslice(discounts, k)
+        next_reset = tslice(resets, k + 1)
+        # Truncation at this transition: boundary crossed without termination.
+        # Gate on d_k > 0 (not the raw value) so fractional/absorbing
+        # discounts still count as truncation rather than a partial leak.
+        is_trunc = next_reset * (d_k > 0.0)
+        ext_valid = live * (1.0 - is_trunc)
+
+        acc_ext = acc + (gamma**k) * cont * tslice(rewards, k)
+        cont_ext = cont * d_k
+        y_ext = acc_ext + (gamma ** (k + 1)) * cont_ext * tslice(
+            bootstrap_q, k + 1
+        )
+        y = jnp.where(ext_valid > 0, y_ext, y)
+        acc = jnp.where(ext_valid > 0, acc_ext, acc)
+        cont = jnp.where(ext_valid > 0, cont_ext, cont)
+        live = live * (1.0 - next_reset)
+    return y
 
 
 def td_errors(q_values: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,6 @@
+"""SPMD parallelism (SURVEY.md §2.8): dp mesh, sharded replay, ICI psum."""
+
+from r2d2dpg_tpu.parallel.mesh import DP_AXIS, make_mesh, replicated, sharded
+from r2d2dpg_tpu.parallel.spmd import SPMDTrainer
+
+__all__ = ["DP_AXIS", "SPMDTrainer", "make_mesh", "replicated", "sharded"]

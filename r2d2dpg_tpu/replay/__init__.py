@@ -1,0 +1,10 @@
+"""HBM sequence replay (SURVEY.md §2.2): ring arena, prioritized sampling."""
+
+from r2d2dpg_tpu.replay.arena import (
+    ArenaState,
+    ReplayArena,
+    SampleResult,
+    SequenceBatch,
+)
+
+__all__ = ["ArenaState", "ReplayArena", "SampleResult", "SequenceBatch"]

@@ -1,0 +1,172 @@
+"""HBM-resident prioritized sequence replay arena.
+
+Reference parity: SURVEY.md §2.2 — the reference keeps a CPU-side ring buffer
+of fixed-length sequences with proportional prioritization (sum-tree or flat
+``np.random.choice``), IS weights, and learner priority write-back, fed by
+actor processes over a queue.
+
+TPU-native design (BASELINE north star "prioritized sequence replay buffer
+lives in HBM"): the arena is a struct-of-arrays pytree of preallocated device
+buffers with ring semantics.  ``add`` / ``sample`` / ``update_priorities`` are
+pure functions that live *inside* the outer jitted training program, so no
+host round-trip ever touches the replay path:
+
+- ``add``: batched scatter of B sequences at the ring cursor.
+- ``sample``: proportional sampling by inverse-CDF over a ``cumsum`` of
+  ``p^alpha`` (O(C) on the VPU, no sum-tree needed — XLA fuses the power,
+  cumsum and searchsorted into a handful of HBM passes) or uniform over the
+  valid prefix.
+- ``update_priorities``: scatter write-back (Pallas kernel on TPU — see
+  ``ops/pallas/scatter.py`` — with an XLA ``.at[].set`` fallback).
+
+Sequence layout (SURVEY §2.2 "sequence format"): each slot stores a
+fixed-length window of ``burnin + unroll + n_step`` steps plus the initial
+recurrent carries of actor and critic nets captured at window start.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from r2d2dpg_tpu.ops.priority import PRIORITY_EPS
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SequenceBatch:
+    """A batch of stored sequences, batch-major ``[B, L, ...]``.
+
+    ``carries`` holds the *initial* recurrent state (window start) per net:
+    ``{"actor": carry, "critic": carry}`` with leaves ``[B, ...]`` (empty
+    pytrees for feedforward nets).
+    """
+
+    obs: jnp.ndarray
+    action: jnp.ndarray
+    reward: jnp.ndarray
+    discount: jnp.ndarray
+    reset: jnp.ndarray
+    carries: Dict[str, Any]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ArenaState:
+    """Device-resident replay storage (a pytree of preallocated buffers)."""
+
+    data: SequenceBatch  # leaves [capacity, L, ...] / carries [capacity, ...]
+    priority: jnp.ndarray  # [capacity] raw priorities; 0 marks empty slots
+    cursor: jnp.ndarray  # next write position
+    total_added: jnp.ndarray  # monotone count of sequences ever added
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SampleResult:
+    batch: SequenceBatch
+    indices: jnp.ndarray  # [B] slot indices, for priority write-back
+    probs: jnp.ndarray  # [B] sampling probabilities (1/N for uniform)
+
+
+class ReplayArena:
+    """Static replay configuration + pure state-transition functions.
+
+    The instance holds only static metadata (capacity, prioritization flag),
+    so it can be closed over by jitted functions; all mutable storage lives in
+    the ``ArenaState`` pytree threaded through ``add``/``sample``/``update``.
+    """
+
+    def __init__(self, capacity: int, *, prioritized: bool = True, alpha: float = 0.6):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self.prioritized = prioritized
+        self.alpha = alpha
+
+    # ------------------------------------------------------------------ init
+    def init_state(self, example: SequenceBatch) -> ArenaState:
+        """Preallocate buffers from one example sequence batch (leading dim B)."""
+
+        def alloc(x):
+            return jnp.zeros((self.capacity,) + x.shape[1:], x.dtype)
+
+        return ArenaState(
+            data=jax.tree_util.tree_map(alloc, example),
+            priority=jnp.zeros((self.capacity,), jnp.float32),
+            cursor=jnp.zeros((), jnp.int32),
+            total_added=jnp.zeros((), jnp.int32),
+        )
+
+    # ------------------------------------------------------------------- add
+    def add(
+        self, state: ArenaState, batch: SequenceBatch, priorities: jnp.ndarray
+    ) -> ArenaState:
+        """Scatter B new sequences at the ring cursor (FIFO overwrite)."""
+        b = priorities.shape[0]
+        idx = (state.cursor + jnp.arange(b, dtype=jnp.int32)) % self.capacity
+
+        data = jax.tree_util.tree_map(
+            lambda buf, new: buf.at[idx].set(new), state.data, batch
+        )
+        priority = state.priority.at[idx].set(
+            jnp.maximum(priorities, PRIORITY_EPS)
+        )
+        return ArenaState(
+            data=data,
+            priority=priority,
+            cursor=(state.cursor + b) % self.capacity,
+            total_added=state.total_added + b,
+        )
+
+    # ------------------------------------------------------------------ size
+    def size(self, state: ArenaState) -> jnp.ndarray:
+        return jnp.minimum(state.total_added, self.capacity)
+
+    # ---------------------------------------------------------------- sample
+    def sample(
+        self, state: ArenaState, key: jax.Array, batch_size: int
+    ) -> SampleResult:
+        """Draw ``batch_size`` sequences (proportional-prioritized or uniform).
+
+        Caller must ensure the arena is non-empty (the training loop gates on
+        a warm-up size; SURVEY §2.5 "Lifecycle" row).
+        """
+        size = self.size(state)
+        if self.prioritized:
+            # p^alpha over valid slots (empty slots have priority 0).
+            scaled = jnp.where(
+                state.priority > 0.0, state.priority**self.alpha, 0.0
+            )
+            total = scaled.sum()
+            cdf = jnp.cumsum(scaled)
+            u = jax.random.uniform(key, (batch_size,)) * total
+            indices = jnp.clip(
+                jnp.searchsorted(cdf, u, side="right"), 0, self.capacity - 1
+            )
+            probs = scaled[indices] / jnp.maximum(total, 1e-12)
+        else:
+            indices = jax.random.randint(
+                key, (batch_size,), 0, jnp.maximum(size, 1)
+            )
+            probs = jnp.full(
+                (batch_size,), 1.0 / jnp.maximum(size.astype(jnp.float32), 1.0)
+            )
+
+        batch = jax.tree_util.tree_map(lambda buf: buf[indices], state.data)
+        return SampleResult(batch=batch, indices=indices, probs=probs)
+
+    # ------------------------------------------------------- priority update
+    def update_priorities(
+        self, state: ArenaState, indices: jnp.ndarray, priorities: jnp.ndarray
+    ) -> ArenaState:
+        """Learner write-back of fresh sequence priorities (SURVEY §2.4)."""
+        from r2d2dpg_tpu.ops.pallas import priority_scatter
+
+        new_priority = priority_scatter(
+            state.priority, indices, jnp.maximum(priorities, PRIORITY_EPS)
+        )
+        return dataclasses.replace(state, priority=new_priority)

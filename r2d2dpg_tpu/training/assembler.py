@@ -1,0 +1,83 @@
+"""Sliding-window sequence assembler.
+
+Reference parity: SURVEY.md §2.3 "Local sequence assembler" — the reference
+actor keeps a sliding window over the episode and, every ``stride`` steps,
+emits a fixed-length sequence with stored initial LSTM state; adjacent
+sequences overlap by ``seq_len - stride`` (SURVEY §2.2: "adjacent sequences
+overlap by half").
+
+TPU-native: the window is a struct-of-arrays ``[num_envs, L, ...]`` device
+buffer.  Each actor phase collects ``stride`` fresh steps (stacked scan
+outputs), shifts them in with one concatenate, and the full window is emitted
+as ``num_envs`` sequences — no Python-side deques, no per-step host work.
+Episode boundaries are *not* special-cased at emission: the per-step
+``reset`` flags ride inside the sequence and the learner's unroll re-zeroes
+carries mid-sequence (SURVEY §7 hard part 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from r2d2dpg_tpu.replay.arena import SequenceBatch
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class StepRecord:
+    """Per-step data recorded by the actor phase (leaves ``[..., E, ...]``).
+
+    ``carries`` holds each net's recurrent state *before* processing
+    ``obs`` — at emission, position 0's carries become the sequence's stored
+    initial state (SURVEY §2.1: learner re-inits from stored state).
+    """
+
+    obs: jnp.ndarray
+    action: jnp.ndarray
+    reward: jnp.ndarray
+    discount: jnp.ndarray
+    reset: jnp.ndarray
+    carries: Dict[str, Any]
+
+
+def init_window(example: StepRecord, seq_len: int) -> StepRecord:
+    """Zero window ``[E, L, ...]`` from a single-step example ``[E, ...]``."""
+
+    def alloc(x):
+        return jnp.zeros(x.shape[:1] + (seq_len,) + x.shape[1:], x.dtype)
+
+    return jax.tree_util.tree_map(alloc, example)
+
+
+def shift_in(window: StepRecord, fresh: StepRecord) -> StepRecord:
+    """Append ``stride`` time-major fresh steps ``[S, E, ...]``, drop the oldest.
+
+    ``fresh`` comes straight from ``lax.scan``'s stacked outputs (time-major);
+    the window is batch-major, so each leaf is transposed then concatenated.
+    """
+
+    def upd(buf, new):
+        new_bm = jnp.swapaxes(new, 0, 1)  # [S, E, ...] -> [E, S, ...]
+        stride = new_bm.shape[1]
+        return jnp.concatenate([buf[:, stride:], new_bm], axis=1)
+
+    return jax.tree_util.tree_map(upd, window, fresh)
+
+
+def emit(window: StepRecord) -> SequenceBatch:
+    """The current window as a batch of sequences (one per env lane).
+
+    Stored carries are the per-step carries at window position 0.
+    """
+    return SequenceBatch(
+        obs=window.obs,
+        action=window.action,
+        reward=window.reward,
+        discount=window.discount,
+        reset=window.reset,
+        carries=jax.tree_util.tree_map(lambda c: c[:, 0], window.carries),
+    )

@@ -1,0 +1,422 @@
+"""The Anakin-style trainer: actor phase + learner phase as one device program.
+
+Reference parity: SURVEY.md §2.5 / §3.1 — the reference's ``main.py`` spawns
+N actor processes and a learner wired by ``multiprocessing.Queue``s.  Here
+the topology dissolves (SURVEY §7 "design inversion", PAPERS.md 2104.06272):
+
+- the actor pool     -> a vmapped env batch stepped inside ``lax.scan``;
+- the exp queue      -> the window assembler + an in-graph ``arena.add``;
+- the param channel  -> the behavior-params snapshot (see staleness knob);
+- the learner proc   -> ``learner_steps`` jitted updates per phase;
+- warm-up gating     -> a *static* phase schedule (window-fill phases, then
+                        replay-fill phases, then full train phases), so no
+                        data-dependent control flow enters the jit graphs.
+
+Phases:
+  ``collect_phase``  env stepping + window shift only (warm-up).
+  ``fill_phase``     + sequence emission into the replay arena.
+  ``train_phase``    + K learner steps with prioritized sampling, IS
+                     weights, priority write-back, Polyak updates.
+
+Off-policy lag (SURVEY §7 hard part 4): with ``param_sync_every == 0``
+actors always use fresh params (Anakin default — *less* lag than the
+reference's stale-param actors).  Setting it to K > 0 reproduces reference
+fidelity: behavior params refresh from learner params every K phases,
+in-graph.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from r2d2dpg_tpu.agents.ddpg import R2D2DPG, TrainState
+from r2d2dpg_tpu.envs.core import Environment
+from r2d2dpg_tpu.ops import anneal_beta, gaussian_noise, importance_weights, ou_step, sigma_ladder
+from r2d2dpg_tpu.replay.arena import ArenaState, ReplayArena, SequenceBatch
+from r2d2dpg_tpu.training.assembler import StepRecord, emit, init_window, shift_in
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainerConfig:
+    """Static orchestration hyperparameters (SURVEY §2.5)."""
+
+    num_envs: int = 64
+    stride: int = 20  # env steps per phase == emission stride
+    learner_steps: int = 1  # learner updates per phase
+    batch_size: int = 64
+    capacity: int = 100_000
+    prioritized: bool = True
+    priority_alpha: float = 0.6
+    beta0: float = 0.4
+    beta_steps: int = 100_000
+    min_replay: int = 1_000  # sequences before training starts
+    sigma_max: float = 0.4
+    ladder_alpha: float = 7.0
+    ladder_kind: str = "geometric"
+    noise: str = "gaussian"  # "gaussian" | "ou" | "none"
+    param_sync_every: int = 0  # 0 = always-fresh behavior params (Anakin)
+    initial_priority: str = "td"  # "td" | "max"  (SURVEY §2.2 initial priority)
+    seed: int = 0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TrainerState:
+    """Everything the training program threads through phases (one pytree)."""
+
+    env_state: Any  # vmapped env states [E, ...]
+    obs: jnp.ndarray  # [E, obs]
+    reset: jnp.ndarray  # [E] — 1 where obs starts a new episode
+    actor_carry: Any
+    critic_carry: Any
+    noise_state: jnp.ndarray  # [E, A] (OU process state; zeros for gaussian)
+    window: StepRecord
+    arena: ArenaState
+    train: TrainState
+    behavior_params: Any  # stale actor params (== train.actor_params when fresh)
+    rng: jax.Array
+    phase_idx: jnp.ndarray
+    env_steps: jnp.ndarray
+    episode_return: jnp.ndarray  # [E] running returns
+    completed_return_sum: jnp.ndarray
+    completed_count: jnp.ndarray
+
+
+class Trainer:
+    """Builds the jitted phase functions for (env, agent, config).
+
+    Distribution hooks (overridden by ``parallel.SPMDTrainer``): ``axis``
+    names the mesh axis the phases run under (None = single device);
+    ``global_envs`` is the fleet-wide env count (== ``config.num_envs``
+    locally); ``_local_sigmas`` returns this shard's slice of the global
+    noise ladder; ``_psum``/``_fold_axis`` reduce/diversify across devices.
+    """
+
+    axis: Optional[str] = None
+
+    def __init__(self, env: Environment, agent: R2D2DPG, config: TrainerConfig):
+        self.env = env
+        self.agent = agent
+        self.config = config
+        self.seq_len = agent.config.seq_len
+        self.arena = ReplayArena(
+            config.capacity,
+            prioritized=config.prioritized,
+            alpha=config.priority_alpha,
+        )
+        self.global_envs = config.num_envs
+        self._build_phases()
+
+    def _build_phases(self):
+        donate = dict(donate_argnums=(0,))
+        self.collect_phase = jax.jit(self._collect_phase, **donate)
+        self.fill_phase = jax.jit(self._fill_phase, **donate)
+        self.train_phase = jax.jit(self._train_phase, **donate)
+
+    # ----------------------------------------------------- distribution hooks
+    def _local_sigmas(self) -> jnp.ndarray:
+        """This device's slice of the global per-actor noise ladder."""
+        sigmas = sigma_ladder(
+            self.global_envs,
+            sigma_max=self.config.sigma_max,
+            alpha=self.config.ladder_alpha,
+            kind=self.config.ladder_kind,
+        )
+        if self.axis is None:
+            return sigmas
+        idx = lax.axis_index(self.axis)
+        return lax.dynamic_slice(
+            sigmas, (idx * self.config.num_envs,), (self.config.num_envs,)
+        )
+
+    def _psum(self, x):
+        """Sum a per-device partial across the mesh (identity single-device)."""
+        return x if self.axis is None else lax.psum(x, self.axis)
+
+    def _pmean(self, x):
+        return x if self.axis is None else lax.pmean(x, self.axis)
+
+    def _fold_axis(self, key: jax.Array) -> jax.Array:
+        """Diversify an (otherwise replicated) RNG key per device."""
+        if self.axis is None:
+            return key
+        return jax.random.fold_in(key, lax.axis_index(self.axis))
+
+    # ------------------------------------------------------------------ init
+    def init(self, key: Optional[jax.Array] = None) -> TrainerState:
+        cfg = self.config
+        key = jax.random.PRNGKey(cfg.seed) if key is None else key
+        k_env, k_agent, k_run = jax.random.split(key, 3)
+
+        if getattr(self.env, "batched", False):
+            env_state, ts = self.env.reset(k_env, cfg.num_envs)
+        else:
+            env_keys = jax.random.split(k_env, cfg.num_envs)
+            env_state, ts = jax.vmap(self.env.reset)(env_keys)
+
+        e = cfg.num_envs
+        a_dim = self.env.spec.action_dim
+        example_action = jnp.zeros((e, a_dim))
+        train = self.agent.init(k_agent, ts.obs, example_action)
+
+        actor_carry = self.agent.actor.initial_carry(e)
+        critic_carry = self.agent.critic.initial_carry(e)
+        record = StepRecord(
+            obs=ts.obs,
+            action=example_action,
+            reward=ts.reward,
+            discount=ts.discount,
+            reset=ts.reset,
+            carries={"actor": actor_carry, "critic": critic_carry},
+        )
+        window = init_window(record, self.seq_len)
+
+        example_seq = emit(window)
+        arena_state = self.arena.init_state(example_seq)
+
+        return TrainerState(
+            env_state=env_state,
+            obs=ts.obs,
+            reset=ts.reset,
+            actor_carry=actor_carry,
+            critic_carry=critic_carry,
+            noise_state=jnp.zeros((e, a_dim)),
+            window=window,
+            arena=arena_state,
+            train=train,
+            behavior_params=jax.tree_util.tree_map(jnp.copy, train.actor_params),
+            rng=k_run,
+            phase_idx=jnp.zeros((), jnp.int32),
+            env_steps=jnp.zeros((), jnp.int64)
+            if jax.config.jax_enable_x64
+            else jnp.zeros((), jnp.int32),
+            episode_return=jnp.zeros((e,)),
+            completed_return_sum=jnp.zeros(()),
+            completed_count=jnp.zeros(()),
+        )
+
+    # --------------------------------------------------------- phase pieces
+    def _behavior_params(self, state: TrainerState):
+        if self.config.param_sync_every == 0:
+            return state.train.actor_params
+        refresh = (state.phase_idx % self.config.param_sync_every) == 0
+        return jax.tree_util.tree_map(
+            lambda fresh, stale: jnp.where(refresh, fresh, stale),
+            state.train.actor_params,
+            state.behavior_params,
+        )
+
+    def _collect(self, state: TrainerState) -> Tuple[TrainerState, StepRecord]:
+        """Scan ``stride`` vmapped env steps; returns time-major records.
+
+        SURVEY §3.2's hot loop A, vectorized: policy forward (behavior
+        params), exploration noise, env step, episode bookkeeping.  The
+        critic also steps along so its recurrent state exists for storage
+        (R2D2-DPG stores initial state for *both* nets' cores).
+        """
+        cfg = self.config
+        behavior = self._behavior_params(state)
+        critic_params = state.train.critic_params
+        sigmas = self._local_sigmas()
+        rng, scan_key = jax.random.split(state.rng)
+        scan_key = self._fold_axis(scan_key)
+
+        def step(carry, key):
+            env_state, obs, reset, a_carry, c_carry, noise_st, ep_ret = carry
+            pre_carries = {"actor": a_carry, "critic": c_carry}
+
+            action, a_carry = self.agent.actor.apply(behavior, obs, a_carry, reset)
+            k_noise, k_env = jax.random.split(key)
+            if cfg.noise == "gaussian":
+                action = action + gaussian_noise(k_noise, action, sigmas)
+            elif cfg.noise == "ou":
+                noise_st = jnp.where(reset[:, None] > 0, 0.0, noise_st)
+                noise_st = ou_step(k_noise, noise_st, sigmas)
+                action = action + noise_st
+            action = jnp.clip(action, -1.0, 1.0)
+
+            _, c_carry = self.agent.critic.apply(
+                critic_params, obs, action, c_carry, reset
+            )
+
+            if getattr(self.env, "batched", False):
+                env_state, ts = self.env.step(env_state, action, k_env)
+            else:
+                env_keys = jax.random.split(k_env, cfg.num_envs)
+                env_state, ts = jax.vmap(self.env.step)(
+                    env_state, action, env_keys
+                )
+
+            record = StepRecord(
+                obs=obs,
+                action=action,
+                reward=ts.reward,
+                discount=ts.discount,
+                reset=reset,
+                carries=pre_carries,
+            )
+            ep_ret = ep_ret + ts.reward
+            done = ts.reset > 0
+            completed = (jnp.where(done, ep_ret, 0.0).sum(), done.sum())
+            ep_ret = jnp.where(done, 0.0, ep_ret)
+            carry = (env_state, ts.obs, ts.reset, a_carry, c_carry, noise_st, ep_ret)
+            return carry, (record, completed)
+
+        init = (
+            state.env_state,
+            state.obs,
+            state.reset,
+            state.actor_carry,
+            state.critic_carry,
+            state.noise_state,
+            state.episode_return,
+        )
+        keys = jax.random.split(scan_key, cfg.stride)
+        (env_state, obs, reset, a_carry, c_carry, noise_st, ep_ret), (
+            records,
+            (comp_sum, comp_cnt),
+        ) = lax.scan(step, init, keys)
+
+        state = dataclasses.replace(
+            state,
+            env_state=env_state,
+            obs=obs,
+            reset=reset,
+            actor_carry=a_carry,
+            critic_carry=c_carry,
+            noise_state=noise_st,
+            rng=rng,
+            env_steps=state.env_steps + cfg.stride * self.global_envs,
+            episode_return=ep_ret,
+            completed_return_sum=state.completed_return_sum
+            + self._psum(comp_sum.sum()),
+            completed_count=state.completed_count + self._psum(comp_cnt.sum()),
+            window=shift_in(state.window, records),
+            phase_idx=state.phase_idx + 1,
+        )
+        return state
+
+    def _emit_and_add(self, state: TrainerState) -> TrainerState:
+        """Emit the window as one sequence per env and add with priority."""
+        seq = emit(state.window)
+        if self.config.initial_priority == "td" and self.config.prioritized:
+            prios = self.agent.initial_priority(state.train, seq)
+        elif self.config.prioritized:
+            prios = jnp.full(
+                (self.config.num_envs,),
+                jnp.maximum(state.arena.priority.max(), 1.0),
+            )
+        else:
+            prios = jnp.ones((self.config.num_envs,))
+        arena = self.arena.add(state.arena, seq, prios)
+        return dataclasses.replace(state, arena=arena)
+
+    def _learn(self, state: TrainerState) -> Tuple[TrainerState, Dict[str, jnp.ndarray]]:
+        """K learner updates: sample -> update -> priority write-back."""
+        cfg = self.config
+        rng, key = jax.random.split(state.rng)
+        key = self._fold_axis(key)
+
+        def one(carry, key):
+            train, arena = carry
+            res = self.arena.sample(arena, key, cfg.batch_size)
+            if cfg.prioritized:
+                beta = anneal_beta(train.step, beta0=cfg.beta0, steps=cfg.beta_steps)
+                w = importance_weights(res.probs, self.arena.size(arena), beta=beta)
+            else:
+                w = jnp.ones((cfg.batch_size,))
+            train, prios, metrics = self.agent.learner_step(train, res.batch, w)
+            if cfg.prioritized:
+                arena = self.arena.update_priorities(arena, res.indices, prios)
+            return (train, arena), metrics
+
+        keys = jax.random.split(key, cfg.learner_steps)
+        (train, arena), metrics = lax.scan(one, (state.train, state.arena), keys)
+        metrics = jax.tree_util.tree_map(lambda m: self._pmean(m.mean()), metrics)
+        state = dataclasses.replace(state, train=train, arena=arena, rng=rng)
+        return state, metrics
+
+    # -------------------------------------------------------------- phases
+    def _collect_phase(self, state: TrainerState) -> TrainerState:
+        return self._collect(state)
+
+    def _fill_phase(self, state: TrainerState) -> TrainerState:
+        return self._emit_and_add(self._collect(state))
+
+    def _train_phase(
+        self, state: TrainerState
+    ) -> Tuple[TrainerState, Dict[str, jnp.ndarray]]:
+        if self.config.param_sync_every > 0:
+            # Persist the snapshot *before* collecting (phase_idx is still
+            # this phase's index), so the params _collect acts with are
+            # exactly the ones carried forward until the next sync phase.
+            state = dataclasses.replace(
+                state, behavior_params=self._behavior_params(state)
+            )
+        state = self._emit_and_add(self._collect(state))
+        return self._learn(state)
+
+    # ------------------------------------------------------------ schedule
+    @property
+    def window_fill_phases(self) -> int:
+        """Phases needed before the window holds seq_len real steps."""
+        return -(-self.seq_len // self.config.stride)  # ceil div
+
+    @property
+    def replay_fill_phases(self) -> int:
+        """Additional phases to reach min_replay sequences."""
+        return -(-self.config.min_replay // self.config.num_envs)
+
+    def pop_episode_metrics(
+        self, state: TrainerState
+    ) -> Tuple[TrainerState, Dict[str, float]]:
+        """Host-side: drain the completed-episode accumulators (L6 logging)."""
+        count = float(state.completed_count)
+        mean_ret = float(state.completed_return_sum) / max(count, 1.0)
+        metrics = {
+            "episode_return_mean": mean_ret,
+            "episodes": count,
+            "env_steps": float(state.env_steps),
+        }
+        state = dataclasses.replace(
+            state,
+            completed_return_sum=jnp.zeros(()),
+            completed_count=jnp.zeros(()),
+        )
+        return state, metrics
+
+    # ----------------------------------------------------------- main loop
+    def run(
+        self,
+        num_phases: int,
+        state: Optional[TrainerState] = None,
+        log_every: int = 50,
+        log_fn=print,
+    ) -> TrainerState:
+        """Drive the static phase schedule (warm-up -> fill -> train)."""
+        state = self.init() if state is None else state
+        warm, fill = self.window_fill_phases, self.replay_fill_phases
+        last_metrics: Dict[str, jnp.ndarray] = {}
+        for phase in range(num_phases):
+            if phase < warm:
+                state = self.collect_phase(state)
+            elif phase < warm + fill:
+                state = self.fill_phase(state)
+            else:
+                state, last_metrics = self.train_phase(state)
+            if log_every and (phase + 1) % log_every == 0:
+                state, ep = self.pop_episode_metrics(state)
+                scalars = {k: float(v) for k, v in last_metrics.items()}
+                log_fn(
+                    f"phase {phase + 1}/{num_phases} "
+                    f"env_steps {int(ep['env_steps'])} "
+                    f"return {ep['episode_return_mean']:.1f} "
+                    f"({int(ep['episodes'])} eps) "
+                    + " ".join(f"{k} {v:.3g}" for k, v in scalars.items())
+                )
+        return state

@@ -2,18 +2,34 @@
 
 Multi-chip TPU hardware is unavailable in CI; all sharding/collective code
 paths execute on 8 virtual CPU devices via
-``--xla_force_host_platform_device_count``.  Must be set before jax imports.
+``--xla_force_host_platform_device_count``.
+
+This box routes JAX to one real TPU chip through the "axon" plugin, which a
+sitecustomize hook registers for *every* python process when
+``PALLAS_AXON_POOL_IPS`` is set, pinning ``JAX_PLATFORMS=axon``.  Tests must
+run on the CPU mesh, so both knobs are overridden — unconditionally, and
+before jax is imported.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# Exercise Pallas kernels via the interpreter on CPU (SURVEY §4: the kernel
+# logic itself is under test; the Mosaic-compiled path runs on real TPU).
+os.environ.setdefault("R2D2DPG_PALLAS_INTERPRET", "1")
 
 import jax  # noqa: E402
 
+# The axon sitecustomize hook pins jax_platforms="axon,cpu" at interpreter
+# startup (before conftest runs); config.update after import wins it back.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+assert jax.default_backend() == "cpu", (
+    "tests must run on the virtual CPU mesh, got " + jax.default_backend()
+)
+assert len(jax.devices()) == 8

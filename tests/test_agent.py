@@ -1,0 +1,169 @@
+"""Learner-step tests: loss directions, target updates, priorities, burn-in
+correctness (SURVEY.md §4.1 — "the §4.1 unit tests before anything learns")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.agents import AgentConfig, R2D2DPG
+from r2d2dpg_tpu.models import ActorNet, CriticNet
+from r2d2dpg_tpu.replay.arena import SequenceBatch
+
+B, OBS, ACT, HID = 4, 3, 2, 16
+
+
+def make_agent(use_lstm=True, **kw):
+    cfg = AgentConfig(
+        burnin=kw.pop("burnin", 2 if use_lstm else 0),
+        unroll=kw.pop("unroll", 3),
+        n_step=kw.pop("n_step", 2),
+        **kw,
+    )
+    actor = ActorNet(action_dim=ACT, hidden=HID, use_lstm=use_lstm)
+    critic = CriticNet(hidden=HID, use_lstm=use_lstm)
+    return R2D2DPG(actor, critic, cfg)
+
+
+def make_batch(agent, key=0):
+    L = agent.config.seq_len
+    ks = jax.random.split(jax.random.PRNGKey(key), 3)
+    carries = {
+        "actor": agent.actor.initial_carry(B),
+        "critic": agent.critic.initial_carry(B),
+    }
+    return SequenceBatch(
+        obs=jax.random.normal(ks[0], (B, L, OBS)),
+        action=jax.random.uniform(ks[1], (B, L, ACT), minval=-1, maxval=1),
+        reward=jax.random.normal(ks[2], (B, L)),
+        discount=jnp.ones((B, L)),
+        reset=jnp.zeros((B, L)),
+        carries=carries,
+    )
+
+
+@pytest.mark.parametrize("use_lstm", [True, False])
+def test_learner_step_runs_and_updates(use_lstm):
+    agent = make_agent(use_lstm)
+    batch = make_batch(agent)
+    state = agent.init(
+        jax.random.PRNGKey(0), batch.obs[:, 0], batch.action[:, 0]
+    )
+    new_state, prios, metrics = jax.jit(agent.learner_step)(
+        state, batch, jnp.ones(B)
+    )
+    assert int(new_state.step) == 1
+    assert prios.shape == (B,)
+    assert np.all(np.asarray(prios) > 0)
+    # Params actually moved.
+    moved = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.abs(a - b).max()),
+        state.critic_params,
+        new_state.critic_params,
+    )
+    assert max(jax.tree_util.tree_leaves(moved)) > 0
+    for k in ("critic_loss", "actor_loss", "q_mean", "td_abs_mean"):
+        assert np.isfinite(float(metrics[k])), k
+
+
+def test_target_nets_polyak_not_copy():
+    agent = make_agent(False, tau=0.5)
+    batch = make_batch(agent)
+    state = agent.init(jax.random.PRNGKey(0), batch.obs[:, 0], batch.action[:, 0])
+    new_state, _, _ = agent.learner_step(state, batch, jnp.ones(B))
+    # target' = tau*online' + (1-tau)*target, with target == old online.
+    leaf = lambda t: jax.tree_util.tree_leaves(t)[0]  # noqa: E731
+    want = 0.5 * leaf(new_state.critic_params) + 0.5 * leaf(state.critic_params)
+    np.testing.assert_allclose(
+        np.asarray(leaf(new_state.target_critic_params)),
+        np.asarray(want),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_critic_loss_decreases_on_fixed_batch():
+    """Repeated steps on one batch must reduce critic TD loss (sanity)."""
+    agent = make_agent(False, critic_lr=1e-2, actor_lr=0.0, tau=0.0)
+    batch = make_batch(agent)
+    state = agent.init(jax.random.PRNGKey(0), batch.obs[:, 0], batch.action[:, 0])
+    step = jax.jit(agent.learner_step)
+    first = last = None
+    for _ in range(50):
+        state, _, metrics = step(state, batch, jnp.ones(B))
+        if first is None:
+            first = float(metrics["critic_loss"])
+        last = float(metrics["critic_loss"])
+    assert last < first * 0.5, (first, last)
+
+
+def test_is_weights_scale_critic_gradient():
+    agent = make_agent(False)
+    batch = make_batch(agent)
+    state = agent.init(jax.random.PRNGKey(0), batch.obs[:, 0], batch.action[:, 0])
+    _, _, m1 = agent.learner_step(state, batch, jnp.ones(B))
+    _, _, m2 = agent.learner_step(state, batch, jnp.zeros(B))
+    assert float(m2["critic_loss"]) == 0.0
+    assert float(m1["critic_loss"]) > 0.0
+
+
+def test_burn_in_changes_outcome_only_for_lstm():
+    """Burn-in must affect the training-window carries for LSTM nets."""
+    agent = make_agent(True, burnin=4, unroll=2, n_step=1)
+    batch = make_batch(agent)
+    state = agent.init(jax.random.PRNGKey(0), batch.obs[:, 0], batch.action[:, 0])
+    _, prios_a, _ = agent.learner_step(state, batch, jnp.ones(B))
+
+    # Different burn-in prefix -> different warmed carries -> different TDs.
+    obs2 = batch.obs.at[:, : agent.config.burnin].set(
+        batch.obs[:, : agent.config.burnin] + 1.0
+    )
+    batch2 = SequenceBatch(
+        obs=obs2,
+        action=batch.action,
+        reward=batch.reward,
+        discount=batch.discount,
+        reset=batch.reset,
+        carries=batch.carries,
+    )
+    _, prios_b, _ = agent.learner_step(state, batch2, jnp.ones(B))
+    assert not np.allclose(np.asarray(prios_a), np.asarray(prios_b))
+
+
+def test_reset_inside_window_isolates_past():
+    """A reset at window position t makes the LSTM ignore anything before t:
+    two batches differing only before the reset yield identical TDs after it
+    (SURVEY §7 hard part 2 — the classic silent-correctness bug)."""
+    agent = make_agent(True, burnin=2, unroll=3, n_step=1)
+    L = agent.config.seq_len
+    base = make_batch(agent)
+    reset = jnp.zeros((B, L)).at[:, 2].set(1.0)  # reset at start of window
+
+    def with_obs(obs):
+        return SequenceBatch(
+            obs=obs,
+            action=base.action,
+            reward=base.reward,
+            discount=base.discount,
+            reset=reset,
+            carries=base.carries,
+        )
+
+    state = agent.init(jax.random.PRNGKey(0), base.obs[:, 0], base.action[:, 0])
+    obs_b = base.obs.at[:, :2].set(base.obs[:, :2] * 3.0 + 1.0)
+    _, p1, _ = agent.learner_step(state, with_obs(base.obs), jnp.ones(B))
+    _, p2, _ = agent.learner_step(state, with_obs(obs_b), jnp.ones(B))
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-5)
+
+
+def test_initial_priority_matches_learner_td():
+    """initial_priority must equal the priority the learner would assign
+    (same nets, same batch, before any update)."""
+    agent = make_agent(True)
+    batch = make_batch(agent)
+    state = agent.init(jax.random.PRNGKey(0), batch.obs[:, 0], batch.action[:, 0])
+    p_init = agent.initial_priority(state, batch)
+    _, p_learn, _ = agent.learner_step(state, batch, jnp.ones(B))
+    np.testing.assert_allclose(
+        np.asarray(p_init), np.asarray(p_learn), rtol=1e-4, atol=1e-5
+    )

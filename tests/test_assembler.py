@@ -1,0 +1,79 @@
+"""Sequence-assembler window semantics: shift, overlap, stored carries
+(SURVEY.md §4.1 "sequence assembler overlap/boundary/reset handling")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2dpg_tpu.training.assembler import StepRecord, emit, init_window, shift_in
+
+E, L, S, OBS, H = 2, 6, 3, 4, 5  # envs, window len, stride, obs dim, hidden
+
+
+def record_tm(t0, steps):
+    """Time-major fresh records [S, E, ...] with obs encoding (t, env)."""
+    obs = jnp.stack(
+        [
+            jnp.stack([jnp.full((OBS,), 10.0 * (t0 + s) + e) for e in range(E)])
+            for s in range(steps)
+        ]
+    )
+    carry = (
+        obs[..., :1] * jnp.ones((1, H)),  # [S, E, H] — distinct per (t, env)
+        obs[..., :1] * jnp.ones((1, H)) + 0.5,
+    )
+    return StepRecord(
+        obs=obs,
+        action=jnp.zeros((steps, E, 1)),
+        reward=obs[..., 0],
+        discount=jnp.ones((steps, E)),
+        reset=jnp.zeros((steps, E)),
+        carries={"actor": carry, "critic": carry},
+    )
+
+
+def test_shift_in_keeps_newest_l_steps():
+    single = jax.tree_util.tree_map(lambda x: x[0], record_tm(0, 1))
+    window = init_window(single, L)
+    for phase in range(4):  # 12 steps total through a 6-window
+        window = shift_in(window, record_tm(phase * S, S))
+    # Window must now hold steps 6..11 in order.
+    got = np.asarray(window.obs)[:, :, 0]
+    for e in range(E):
+        np.testing.assert_allclose(got[e], [10.0 * t + e for t in range(6, 12)])
+
+
+def test_emit_takes_carry_at_window_start():
+    single = jax.tree_util.tree_map(lambda x: x[0], record_tm(0, 1))
+    window = init_window(single, L)
+    for phase in range(3):
+        window = shift_in(window, record_tm(phase * S, S))
+    seq = emit(window)
+    # Window start is step 3 (9 steps in, window of 6): carry encodes obs[t=3].
+    h = np.asarray(seq.carries["actor"][0])
+    for e in range(E):
+        np.testing.assert_allclose(h[e], 10.0 * 3 + e)
+    assert seq.obs.shape == (E, L, OBS)
+    # Overlap: after one more phase, window start moves by stride.
+    window = shift_in(window, record_tm(9, S))
+    seq2 = emit(window)
+    h2 = np.asarray(seq2.carries["actor"][0])
+    np.testing.assert_allclose(h2[0], 10.0 * 6 + 0)
+    # Overlapping region (L - S steps) is shared between adjacent sequences.
+    np.testing.assert_allclose(
+        np.asarray(seq.obs)[:, S:], np.asarray(seq2.obs)[:, : L - S]
+    )
+
+
+def test_empty_carries_feedforward():
+    rec = StepRecord(
+        obs=jnp.zeros((E, OBS)),
+        action=jnp.zeros((E, 1)),
+        reward=jnp.zeros((E,)),
+        discount=jnp.ones((E,)),
+        reset=jnp.zeros((E,)),
+        carries={"actor": (), "critic": ()},
+    )
+    window = init_window(rec, L)
+    seq = emit(window)
+    assert seq.carries == {"actor": (), "critic": ()}

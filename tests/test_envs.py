@@ -1,0 +1,100 @@
+"""Environment tests: Pendulum dynamics vs gymnasium, auto-reset semantics,
+DMC host-callback pool (SURVEY.md §2.6)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.envs import Pendulum
+from r2d2dpg_tpu.envs.pendulum import PendulumState
+
+
+def test_pendulum_matches_gymnasium():
+    """Step-for-step parity with gymnasium's Pendulum-v1 dynamics."""
+    import gymnasium as gym
+
+    genv = gym.make("Pendulum-v1")
+    genv.reset(seed=0)
+    th, thdot = 1.3, -0.7
+    genv.unwrapped.state = np.array([th, thdot])
+    env = Pendulum()
+    s = PendulumState(
+        theta=jnp.array(th), thdot=jnp.array(thdot), t=jnp.zeros((), jnp.int32)
+    )
+    max_diff = 0.0
+    for i in range(50):
+        a = np.array([np.sin(i * 0.3)], np.float32)
+        gobs, grew, _, _, _ = genv.step(a * 2.0)  # gym takes raw torque
+        s, ts = env.step(s, jnp.array(a), jax.random.PRNGKey(i))
+        max_diff = max(
+            max_diff,
+            float(np.abs(np.asarray(ts.obs) - gobs).max()),
+            abs(float(ts.reward) - float(grew)),
+        )
+    assert max_diff < 1e-4, max_diff
+
+
+def test_pendulum_autoreset_truncation_semantics():
+    env = Pendulum()
+    s = PendulumState(
+        theta=jnp.array(0.5), thdot=jnp.array(0.0), t=jnp.array(199, jnp.int32)
+    )
+    s2, ts = env.step(s, jnp.array([0.0]), jax.random.PRNGKey(0))
+    assert float(ts.reset) == 1.0  # new episode begins
+    assert float(ts.discount) == 1.0  # truncation, NOT termination
+    assert int(s2.t) == 0
+    # reward still belongs to the old episode's final transition
+    assert float(ts.reward) != 0.0
+
+
+def test_pendulum_vmapped_rollout_jit():
+    env = Pendulum()
+    B, T = 4, 30
+    keys = jax.random.split(jax.random.PRNGKey(0), B)
+    state, ts = jax.vmap(env.reset)(keys)
+
+    @jax.jit
+    def rollout(state, obs, key):
+        def step(carry, k):
+            state, _ = carry
+            ks = jax.random.split(k, B)
+            state, ts = jax.vmap(env.step)(
+                state, jnp.zeros((B, 1)), ks
+            )
+            return (state, ts.obs), ts.reward
+        (state, obs), rews = jax.lax.scan(
+            step, (state, obs), jax.random.split(key, T)
+        )
+        return rews
+
+    rews = rollout(state, ts.obs, jax.random.PRNGKey(1))
+    assert rews.shape == (T, B)
+    assert np.all(np.asarray(rews) <= 0)
+
+
+@pytest.mark.slow
+def test_dmc_host_env_walker():
+    """Host-callback pool: spec, reset/step shapes, action rescale, ordering."""
+    from r2d2dpg_tpu.envs import DMCHostEnv
+
+    env = DMCHostEnv("walker", "walk")
+    assert env.spec.obs_shape == (24,)
+    assert env.spec.action_dim == 6
+    assert env.spec.episode_length == 1000
+    state, ts = env.reset(jax.random.PRNGKey(0), 3)
+    assert ts.obs.shape == (3, 24)
+    assert np.all(np.asarray(ts.reset) == 1.0)
+
+    @jax.jit
+    def five_steps(state, key):
+        def step(carry, k):
+            state = carry
+            state, ts = env.step(state, jnp.zeros((3, 6)), k)
+            return state, (ts.reward, ts.discount)
+        return jax.lax.scan(step, state, jax.random.split(key, 5))
+
+    state, (rewards, discounts) = five_steps(state, jax.random.PRNGKey(1))
+    assert rewards.shape == (5, 3)
+    assert np.all(np.asarray(discounts) == 1.0)
+    assert int(state.token) == 5  # dependency chain advanced in order

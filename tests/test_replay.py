@@ -1,0 +1,139 @@
+"""Replay arena: ring overwrite, prioritized sampling distribution, priority
+write-back via the Pallas kernel (interpret mode) — SURVEY.md §4.1/§4.5."""
+
+import os
+
+os.environ["R2D2DPG_PALLAS_INTERPRET"] = "1"  # exercise the kernel on CPU
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.replay import ReplayArena, SequenceBatch
+
+L, OBS, ACT, HID = 4, 3, 2, 8
+
+
+def make_batch(b, value=0.0):
+    zeros = jnp.zeros((b, HID))
+    return SequenceBatch(
+        obs=jnp.full((b, L, OBS), value),
+        action=jnp.zeros((b, L, ACT)),
+        reward=jnp.arange(b, dtype=jnp.float32)[:, None] * jnp.ones((b, L)),
+        discount=jnp.ones((b, L)),
+        reset=jnp.zeros((b, L)),
+        carries={"actor": (zeros, zeros), "critic": (zeros, zeros)},
+    )
+
+
+def test_add_and_size():
+    arena = ReplayArena(capacity=10)
+    state = arena.init_state(make_batch(2))
+    assert int(arena.size(state)) == 0
+    state = arena.add(state, make_batch(2), jnp.ones(2))
+    assert int(arena.size(state)) == 2
+    state = arena.add(state, make_batch(3), jnp.ones(3))
+    assert int(arena.size(state)) == 5
+    assert int(state.cursor) == 5
+
+
+def test_ring_overwrite_fifo():
+    arena = ReplayArena(capacity=4)
+    state = arena.init_state(make_batch(1))
+    for i in range(6):  # 6 adds into capacity 4 -> slots hold adds 2..5
+        b = make_batch(1, value=float(i))
+        state = arena.add(state, b, jnp.ones(1))
+    obs_vals = np.asarray(state.data.obs)[:, 0, 0]
+    # slot k holds add k for k in 4,5 (wrapped to 0,1) and 2,3 at slots 2,3
+    np.testing.assert_allclose(sorted(obs_vals), [2.0, 3.0, 4.0, 5.0])
+    assert int(arena.size(state)) == 4
+
+
+def test_prioritized_sampling_distribution():
+    """chi^2-style check: empirical sampling freq tracks p^alpha (SURVEY §4.1)."""
+    arena = ReplayArena(capacity=4, alpha=1.0)
+    state = arena.init_state(make_batch(4))
+    prios = jnp.array([1.0, 2.0, 3.0, 6.0])
+    state = arena.add(state, make_batch(4), prios)
+
+    n_draws, bsz = 200, 64
+    keys = jax.random.split(jax.random.PRNGKey(0), n_draws)
+    sample = jax.jit(lambda s, k: arena.sample(s, k, bsz).indices)
+    counts = np.zeros(4)
+    for k in keys:
+        idx, c = np.unique(np.asarray(sample(state, k)), return_counts=True)
+        counts[idx] += c
+    freq = counts / counts.sum()
+    want = np.asarray(prios) / float(prios.sum())
+    np.testing.assert_allclose(freq, want, atol=0.02)
+
+
+def test_sample_probs_match_distribution():
+    arena = ReplayArena(capacity=8, alpha=0.7)
+    state = arena.init_state(make_batch(4))
+    prios = jnp.array([0.5, 1.0, 2.0, 4.0])
+    state = arena.add(state, make_batch(4), prios)
+    res = arena.sample(state, jax.random.PRNGKey(1), 16)
+    scaled = np.asarray(prios) ** 0.7
+    want = scaled / scaled.sum()
+    np.testing.assert_allclose(
+        np.asarray(res.probs), want[np.asarray(res.indices)], rtol=1e-5
+    )
+
+
+def test_empty_slots_never_sampled():
+    arena = ReplayArena(capacity=100)
+    state = arena.init_state(make_batch(3))
+    state = arena.add(state, make_batch(3), jnp.ones(3))
+    res = arena.sample(state, jax.random.PRNGKey(2), 256)
+    assert np.asarray(res.indices).max() < 3
+
+
+def test_uniform_sampling():
+    arena = ReplayArena(capacity=50, prioritized=False)
+    state = arena.init_state(make_batch(10))
+    state = arena.add(state, make_batch(10), jnp.ones(10))
+    res = arena.sample(state, jax.random.PRNGKey(3), 512)
+    idx = np.asarray(res.indices)
+    assert idx.min() >= 0 and idx.max() < 10
+    np.testing.assert_allclose(np.asarray(res.probs), 0.1, rtol=1e-6)
+
+
+def test_priority_update_pallas_kernel():
+    """update_priorities runs the Pallas kernel (interpret mode on CPU)."""
+    arena = ReplayArena(capacity=8)
+    state = arena.init_state(make_batch(4))
+    state = arena.add(state, make_batch(4), jnp.ones(4))
+    state = arena.update_priorities(
+        state, jnp.array([0, 2]), jnp.array([5.0, 7.0])
+    )
+    np.testing.assert_allclose(
+        np.asarray(state.priority)[:4], [5.0, 1.0, 7.0, 1.0], rtol=1e-5
+    )
+
+
+def test_priority_update_inside_jit():
+    arena = ReplayArena(capacity=8)
+    state = arena.init_state(make_batch(4))
+    state = arena.add(state, make_batch(4), jnp.ones(4))
+
+    @jax.jit
+    def upd(s):
+        return arena.update_priorities(s, jnp.array([1, 3]), jnp.array([9.0, 2.0]))
+
+    s2 = upd(state)
+    np.testing.assert_allclose(
+        np.asarray(s2.priority)[:4], [1.0, 9.0, 1.0, 2.0], rtol=1e-5
+    )
+
+
+def test_sampled_batch_contents_roundtrip():
+    arena = ReplayArena(capacity=16)
+    state = arena.init_state(make_batch(4))
+    state = arena.add(state, make_batch(4), jnp.array([1e9, 1e-6, 1e-6, 1e-6]))
+    res = arena.sample(state, jax.random.PRNGKey(0), 8)
+    # Overwhelming priority on slot 0 -> nearly all samples are slot 0 with reward row 0.
+    assert (np.asarray(res.indices) == 0).mean() > 0.9
+    row0 = np.asarray(res.batch.reward)[np.asarray(res.indices) == 0]
+    np.testing.assert_allclose(row0, 0.0)

@@ -1,4 +1,5 @@
-"""n-step target math vs hand-computed values (SURVEY.md §4.1)."""
+"""n-step target math vs hand-computed values, including episode-boundary
+semantics (SURVEY.md §4.1)."""
 
 import jax.numpy as jnp
 import numpy as np
@@ -7,70 +8,133 @@ import pytest
 from r2d2dpg_tpu.ops import huber, n_step_targets, td_errors
 
 
-def reference_n_step(r, d, q, n, gamma):
-    """Slow, obviously-correct scalar reference."""
+def reference_n_step(r, d, resets, q, n, gamma):
+    """Slow, obviously-correct scalar reference with boundary handling."""
     T = len(r)
     U = T - n
     ys = []
     for t in range(U):
+        y = q[t]  # horizon-0 fallback
         acc, cont = 0.0, 1.0
         for k in range(n):
+            if resets[t + k + 1] == 1 and d[t + k] == 1:
+                break  # truncation: freeze at horizon k
             acc += (gamma**k) * cont * r[t + k]
             cont *= d[t + k]
-        acc += (gamma**n) * cont * q[t + n]
-        ys.append(acc)
+            y = acc + (gamma ** (k + 1)) * cont * q[t + k + 1]
+            if resets[t + k + 1] == 1:
+                break  # termination boundary: no further extensions
+        else:
+            pass
+        ys.append(y)
     return np.array(ys)
 
 
+def targets(r, d, resets, q, n, gamma=0.97):
+    return np.asarray(
+        n_step_targets(
+            jnp.array(r), jnp.array(d), jnp.array(resets), jnp.array(q),
+            n=n, gamma=gamma,
+        )
+    )
+
+
 @pytest.mark.parametrize("n", [1, 3, 5])
-def test_n_step_matches_scalar_reference(n):
+def test_matches_scalar_reference_no_boundaries(n):
     rng = np.random.RandomState(0)
     T = 12
     r = rng.randn(T).astype(np.float32)
-    d = (rng.rand(T) > 0.2).astype(np.float32)
+    d = np.ones(T, np.float32)
     q = rng.randn(T).astype(np.float32)
-    got = n_step_targets(jnp.array(r), jnp.array(d), jnp.array(q), n=n, gamma=0.97)
-    want = reference_n_step(r, d, q, n, 0.97)
-    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+    z = np.zeros(T, np.float32)
+    got = targets(r, d, z, q, n)
+    want = reference_n_step(r, d, z, q, n, 0.97)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
-def test_n_step_no_termination_closed_form():
-    # Constant reward 1, no terminations, q == 0: y = sum_{k<n} gamma^k.
+@pytest.mark.parametrize("n", [2, 5])
+def test_matches_scalar_reference_with_boundaries(n):
+    rng = np.random.RandomState(1)
+    T = 14
+    r = rng.randn(T).astype(np.float32)
+    q = rng.randn(T).astype(np.float32)
+    d = np.ones(T, np.float32)
+    resets = np.zeros(T, np.float32)
+    # termination at t=3 (d=0, reset follows), truncation at t=8 (d=1, reset).
+    d[3] = 0.0
+    resets[4] = 1.0
+    resets[9] = 1.0
+    got = targets(r, d, resets, q, n)
+    want = reference_n_step(r, d, resets, q, n, 0.97)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_no_termination_closed_form():
     T, n, gamma = 10, 5, 0.9
-    y = n_step_targets(
-        jnp.ones(T), jnp.ones(T), jnp.zeros(T), n=n, gamma=gamma
-    )
+    y = targets(np.ones(T), np.ones(T), np.zeros(T), np.zeros(T), n, gamma)
     want = sum(gamma**k for k in range(n))
-    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-6)
+    np.testing.assert_allclose(y, want, rtol=1e-6)
 
 
-def test_n_step_terminal_cuts_bootstrap_and_rewards():
-    # Termination at t=0 (d[0]=0): y_0 = r_0 only, regardless of q and later r.
+def test_terminal_cuts_bootstrap_and_rewards():
+    # Termination at t=0: y_0 = r_0 only, regardless of q and later rewards.
     T, n = 8, 5
     r = np.arange(1.0, T + 1.0, dtype=np.float32)
     d = np.ones(T, np.float32)
     d[0] = 0.0
+    resets = np.zeros(T, np.float32)
+    resets[1] = 1.0
     q = 100.0 * np.ones(T, np.float32)
-    y = n_step_targets(jnp.array(r), jnp.array(d), jnp.array(q), n=n, gamma=0.99)
-    np.testing.assert_allclose(np.asarray(y)[0], r[0], rtol=1e-6)
+    y = targets(r, d, resets, q, n, 0.99)
+    np.testing.assert_allclose(y[0], r[0], rtol=1e-6)
 
 
-def test_n_step_batched_shapes():
+def test_truncation_shortens_horizon_no_leak():
+    """Auto-reset truncation (reset=1, discount=1): targets before the
+    boundary must bootstrap at the last same-episode state and must NOT see
+    the next episode's rewards or values."""
+    T, n, gamma = 8, 3, 0.9
+    r = np.ones(T, np.float32)
+    r[4:] = 1000.0  # next episode's rewards — must never leak in
+    d = np.ones(T, np.float32)
+    resets = np.zeros(T, np.float32)
+    resets[4] = 1.0  # obs_4 starts a new episode; transition 3->4 truncated
+    q = np.full(T, 7.0, np.float32)
+    q[4:] = -999.0  # next episode's values — must never leak in
+    y = targets(r, d, resets, q, n, gamma)
+    # t=0: full 3-step inside the episode: r0 + g r1 + g^2 r2 + g^3 q3
+    np.testing.assert_allclose(
+        y[0], 1 + gamma + gamma**2 + gamma**3 * 7.0, rtol=1e-6
+    )
+    # t=1: horizon shortened to 2 (bootstrap at q[3], r3 dropped)
+    np.testing.assert_allclose(y[1], 1 + gamma + gamma**2 * 7.0, rtol=1e-6)
+    # t=3: immediate truncation -> horizon 0, y = q[3]
+    np.testing.assert_allclose(y[3], 7.0, rtol=1e-6)
+    # t=4: fresh episode, full horizon within new episode
+    np.testing.assert_allclose(
+        y[4], 1000 * (1 + gamma + gamma**2) + gamma**3 * -999.0, rtol=1e-5
+    )
+
+
+def test_batched_shapes():
     B, T, n = 4, 11, 5
-    r = jnp.ones((B, T))
-    y = n_step_targets(r, jnp.ones((B, T)), jnp.zeros((B, T)), n=n, gamma=0.99)
+    y = n_step_targets(
+        jnp.ones((B, T)), jnp.ones((B, T)), jnp.zeros((B, T)),
+        jnp.zeros((B, T)), n=n, gamma=0.99,
+    )
     assert y.shape == (B, T - n)
 
 
-def test_n_step_rejects_short_sequences():
+def test_rejects_short_sequences():
     with pytest.raises(ValueError):
-        n_step_targets(jnp.ones(5), jnp.ones(5), jnp.ones(5), n=5, gamma=0.99)
+        n_step_targets(
+            jnp.ones(5), jnp.ones(5), jnp.zeros(5), jnp.ones(5), n=5, gamma=0.99
+        )
 
 
 def test_td_errors_and_huber():
     q = jnp.array([1.0, 2.0])
     y = jnp.array([1.5, 0.0])
     np.testing.assert_allclose(np.asarray(td_errors(q, y)), [0.5, -2.0])
-    # Huber: quadratic inside delta, linear outside.
     np.testing.assert_allclose(float(huber(jnp.array(0.5))), 0.125)
     np.testing.assert_allclose(float(huber(jnp.array(2.0))), 0.5 + 1.0)
