@@ -1,0 +1,5 @@
+"""``python -m r2d2dpg_tpu`` == ``python -m r2d2dpg_tpu.train``."""
+
+from r2d2dpg_tpu.train import main
+
+main()

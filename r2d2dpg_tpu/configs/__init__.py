@@ -38,6 +38,10 @@ class ExperimentConfig:
 
     def build(self) -> Trainer:
         env = self.env_factory()
+        agent = self.build_agent(env)
+        return Trainer(env, agent, self.trainer)
+
+    def build_agent(self, env: Environment, axis_name=None) -> R2D2DPG:
         actor = ActorNet(
             action_dim=env.spec.action_dim,
             hidden=self.hidden,
@@ -47,8 +51,20 @@ class ExperimentConfig:
         critic = CriticNet(
             hidden=self.hidden, use_lstm=self.use_lstm, pixels=self.pixels
         )
-        agent = R2D2DPG(actor, critic, self.agent)
-        return Trainer(env, agent, self.trainer)
+        agent_cfg = (
+            dataclasses.replace(self.agent, axis_name=axis_name)
+            if axis_name != self.agent.axis_name
+            else self.agent
+        )
+        return R2D2DPG(actor, critic, agent_cfg)
+
+    def build_spmd(self, mesh) -> "Trainer":
+        """SPMD variant: phases under shard_map on ``mesh`` (dp gradient sync)."""
+        from r2d2dpg_tpu.parallel import DP_AXIS, SPMDTrainer
+
+        env = self.env_factory()
+        agent = self.build_agent(env, axis_name=DP_AXIS)
+        return SPMDTrainer(env, agent, self.trainer, mesh)
 
 
 def _pendulum():
@@ -205,6 +221,26 @@ CHEETAH_PIXELS = ExperimentConfig(
     ),
 )
 
+# Not a BASELINE config: a seconds-scale smoke slice (CI / CLI sanity) with
+# the full R2D2 recipe at toy shapes.
+PENDULUM_TINY = ExperimentConfig(
+    name="pendulum_tiny",
+    env_factory=_pendulum,
+    use_lstm=True,
+    hidden=32,
+    agent=AgentConfig(burnin=2, unroll=4, n_step=2),
+    trainer=TrainerConfig(
+        num_envs=4,
+        stride=4,
+        learner_steps=1,
+        batch_size=8,
+        capacity=256,
+        prioritized=True,
+        min_replay=8,
+        sigma_max=0.3,
+    ),
+)
+
 CONFIGS: Dict[str, ExperimentConfig] = {
     c.name: c
     for c in (
@@ -213,6 +249,7 @@ CONFIGS: Dict[str, ExperimentConfig] = {
         WALKER_R2D2,
         HUMANOID_R2D2,
         CHEETAH_PIXELS,
+        PENDULUM_TINY,
     )
 }
 

@@ -1,0 +1,221 @@
+"""Training entry point: ``python -m r2d2dpg_tpu.train --config walker_r2d2``.
+
+Reference parity: SURVEY.md §2.5 — the reference's ``main.py`` parses flags,
+spawns N actor processes + a learner and runs forever.  Here the same entry
+drives the Anakin phase schedule (warm-up -> replay-fill -> train) on one
+device or an SPMD mesh, wired to the aux subsystems of SURVEY §5:
+checkpoint/resume (orbax), metrics (CSV + TensorBoard, return@wall-clock,
+SPS), deterministic evaluation, profiler traces, NaN-debug mode.
+
+Stop conditions: ``--phases N`` (exact phase count) and/or ``--minutes M``
+(wall-clock budget — the BASELINE metric is return @ 30 min, so
+``--minutes 30`` reproduces the north-star measurement).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from typing import Optional
+
+from r2d2dpg_tpu.configs import CONFIGS, ExperimentConfig, get_config
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="python -m r2d2dpg_tpu.train", description=__doc__
+    )
+    p.add_argument("--config", required=True, choices=sorted(CONFIGS))
+    p.add_argument("--phases", type=int, default=None, help="train phases to run")
+    p.add_argument(
+        "--minutes", type=float, default=None, help="wall-clock budget (stops at whichever of --phases/--minutes hits first)"
+    )
+    p.add_argument("--logdir", default=None, help="metrics/TB/profile output dir")
+    p.add_argument("--log-every", type=int, default=50, help="phases between logs")
+    p.add_argument("--seed", type=int, default=None)
+    # Orchestration scale overrides (SURVEY §2.5 hyperparameter flags).
+    p.add_argument("--num-envs", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--learner-steps", type=int, default=None)
+    p.add_argument("--min-replay", type=int, default=None)
+    p.add_argument(
+        "--param-sync-every", type=int, default=None,
+        help="refresh behavior params every K phases (0 = always fresh)"
+    )
+    # SPMD.
+    p.add_argument(
+        "--spmd", type=int, default=0, metavar="D",
+        help="run under shard_map on a D-device dp mesh (0 = single device)"
+    )
+    # Checkpointing.
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=500, help="phases between checkpoints (0 = off)")
+    p.add_argument("--resume", action="store_true", help="resume from the latest checkpoint in --checkpoint-dir")
+    # Evaluation.
+    p.add_argument("--eval-every", type=int, default=0, help="train phases between deterministic evals (0 = off)")
+    p.add_argument("--eval-envs", type=int, default=10)
+    # Debug / profiling.
+    p.add_argument("--profile-phases", type=int, default=0, help="trace this many train phases into --logdir/profile")
+    p.add_argument("--nan-debug", action="store_true")
+    return p.parse_args(argv)
+
+
+def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
+    t = {}
+    for flag, field in (
+        ("num_envs", "num_envs"),
+        ("batch_size", "batch_size"),
+        ("learner_steps", "learner_steps"),
+        ("min_replay", "min_replay"),
+        ("param_sync_every", "param_sync_every"),
+        ("seed", "seed"),
+    ):
+        v = getattr(args, flag)
+        if v is not None:
+            t[field] = v
+    if t:
+        cfg = dataclasses.replace(
+            cfg, trainer=dataclasses.replace(cfg.trainer, **t)
+        )
+    return cfg
+
+
+def run(args) -> dict:
+    """Drive one experiment; returns the final metrics dict."""
+    import jax
+
+    from r2d2dpg_tpu.training.evaluator import Evaluator
+    from r2d2dpg_tpu.utils import (
+        CheckpointManager,
+        MetricLogger,
+        nan_debug,
+        profile_trace,
+    )
+    from r2d2dpg_tpu.utils.checkpoint import resume_state
+
+    if args.nan_debug:
+        nan_debug(True)
+
+    cfg = _apply_overrides(get_config(args.config), args)
+
+    if args.spmd:
+        from r2d2dpg_tpu.parallel import make_mesh
+
+        trainer = cfg.build_spmd(make_mesh(args.spmd))
+    else:
+        trainer = cfg.build()
+
+    ckpt: Optional[CheckpointManager] = None
+    if args.checkpoint_dir:
+        ckpt = CheckpointManager(
+            args.checkpoint_dir, save_every=args.checkpoint_every
+        )
+
+    evaluator: Optional[Evaluator] = None
+    if args.eval_every:
+        evaluator = Evaluator(
+            cfg.env_factory(), trainer.agent.actor, num_envs=args.eval_envs
+        )
+
+    logger = MetricLogger(args.logdir)
+    deadline = (
+        time.monotonic() + args.minutes * 60 if args.minutes is not None else None
+    )
+
+    if args.resume:
+        if ckpt is None:
+            raise SystemExit("--resume requires --checkpoint-dir")
+        state = resume_state(trainer, ckpt)
+        print(f"resumed from phase {int(state.phase_idx)}", flush=True)
+    else:
+        state = trainer.init()
+
+    warm = trainer.window_fill_phases
+    fill = warm + trainer.replay_fill_phases
+    eval_key = jax.random.PRNGKey(cfg.trainer.seed + 1)
+    last_learn = {}
+    final = {}
+    phase = start = int(state.phase_idx)
+    # --phases counts *train* phases for this invocation: a fresh run stops
+    # after fill + N, a resumed one after N more from wherever it restarted.
+    stop_at = (
+        max(start, fill) + args.phases if args.phases is not None else None
+    )
+    profile_until = None
+    profiler_cm = None
+
+    try:
+        while True:
+            if stop_at is not None and phase >= stop_at:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            if stop_at is None and deadline is None and phase >= fill + 1:
+                break  # nothing requested: run a single train phase (smoke)
+
+            if phase < warm:
+                state = trainer.collect_phase(state)
+            elif phase < fill:
+                state = trainer.fill_phase(state)
+            else:
+                if (
+                    args.profile_phases
+                    and args.logdir
+                    and profile_until is None
+                ):
+                    profile_until = phase + args.profile_phases
+                    profiler_cm = profile_trace(f"{args.logdir}/profile")
+                    profiler_cm.__enter__()
+                state, last_learn = trainer.train_phase(state)
+                if profiler_cm is not None and phase + 1 >= profile_until:
+                    jax.block_until_ready(state.train.step)
+                    profiler_cm.__exit__(None, None, None)
+                    profiler_cm = None
+            phase += 1
+
+            if args.log_every and phase % args.log_every == 0:
+                state, ep = trainer.pop_episode_metrics(state)
+                scalars = dict(ep)
+                scalars.update(
+                    {k: float(v) for k, v in last_learn.items()}
+                )
+                scalars.update(
+                    logger.rates(
+                        env_steps=ep["env_steps"],
+                        learner_steps=float(state.train.step),
+                    )
+                )
+                logger.log(phase, scalars)
+                final = scalars
+
+            if ckpt is not None and ckpt.save_every:
+                ckpt.maybe_save(phase, state)
+
+            if (
+                evaluator is not None
+                and phase > fill
+                and (phase - fill) % args.eval_every == 0
+            ):
+                eval_key, k = jax.random.split(eval_key)
+                ev = evaluator.run(state.train.actor_params, k)
+                logger.log(phase, ev)
+                final.update(ev)
+    finally:
+        if profiler_cm is not None:
+            profiler_cm.__exit__(None, None, None)
+        if ckpt is not None:
+            if ckpt.save_every:
+                ckpt.save(phase, state)
+            ckpt.wait()
+            ckpt.close()
+        logger.close()
+    return final
+
+
+def main(argv=None):
+    run(parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,9 +1,11 @@
 """Training orchestration (SURVEY.md §2.5): the Anakin phase loop."""
 
 from r2d2dpg_tpu.training.assembler import StepRecord, emit, init_window, shift_in
+from r2d2dpg_tpu.training.evaluator import Evaluator
 from r2d2dpg_tpu.training.trainer import Trainer, TrainerConfig, TrainerState
 
 __all__ = [
+    "Evaluator",
     "StepRecord",
     "Trainer",
     "TrainerConfig",

@@ -1,0 +1,91 @@
+"""Deterministic evaluation rollouts (noise-free policy).
+
+Reference parity: the reference's only "evaluation" is the noisy actors'
+episode returns printed to stdout (SURVEY.md §2.7).  Heterogeneous-noise
+returns systematically understate the policy (the high-sigma rungs of the
+ladder drag the mean down), so the build adds what the BASELINE metric
+actually needs — **return of the deterministic policy mu(s)** — measured by
+rolling a fleet of eval envs for one episode each with zero exploration
+noise.  This is the number the north star (walker-walk >= 900 @ 30 min) is
+scored on.
+
+The rollout is one jitted ``lax.scan`` over ``episode_length`` steps (the
+whole eval is a single device program; for host-callback envs the physics
+crosses to host per step exactly as in training).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from r2d2dpg_tpu.envs.core import Environment
+from r2d2dpg_tpu.models.actor_critic import ActorNet
+
+
+class Evaluator:
+    """Rolls ``num_envs`` noise-free episodes and reports the mean return.
+
+    Separate env instance from the training fleet (host-backed pools are
+    stateful; sharing one would corrupt training episodes).
+    """
+
+    def __init__(self, env: Environment, actor: ActorNet, num_envs: int = 10):
+        self.env = env
+        self.actor = actor
+        self.num_envs = num_envs
+        self._rollout = jax.jit(self._rollout_impl)
+
+    def _rollout_impl(self, actor_params, key) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        env, e = self.env, self.num_envs
+        k_reset, k_scan = jax.random.split(key)
+        if getattr(env, "batched", False):
+            env_state, ts = env.reset(k_reset, e)
+        else:
+            env_state, ts = jax.vmap(env.reset)(jax.random.split(k_reset, e))
+
+        carry0 = self.actor.initial_carry(e)
+
+        def step(carry, k):
+            env_state, obs, reset, a_carry, alive, ep_ret = carry
+            action, a_carry = self.actor.apply(actor_params, obs, a_carry, reset)
+            if getattr(env, "batched", False):
+                env_state, ts = env.step(env_state, action, k)
+            else:
+                env_state, ts = jax.vmap(env.step)(
+                    env_state, action, jax.random.split(k, e)
+                )
+            # ts.reward belongs to the episode that was live before any
+            # auto-reset (envs/core.py TimeStep contract), so credit it while
+            # ``alive``; then retire envs whose episode just ended.
+            ep_ret = ep_ret + ts.reward * alive
+            alive = alive * (1.0 - ts.reset)
+            return (env_state, ts.obs, ts.reset, a_carry, alive, ep_ret), ()
+
+        init = (
+            env_state,
+            ts.obs,
+            ts.reset,
+            carry0,
+            jnp.ones((e,)),
+            jnp.zeros((e,)),
+        )
+        keys = jax.random.split(k_scan, env.spec.episode_length)
+        (_, _, _, _, alive, ep_ret), _ = lax.scan(step, init, keys)
+        return ep_ret, alive
+
+    def run(self, actor_params, key: jax.Array) -> dict:
+        """Mean/min/max deterministic return over the eval fleet."""
+        ep_ret, alive = self._rollout(actor_params, key)
+        # Episodes still alive after episode_length steps (possible only if
+        # the env's true horizon exceeds spec.episode_length) still count:
+        # their partial return is a lower bound.
+        ep_ret = jax.device_get(ep_ret)
+        return {
+            "eval_return_mean": float(ep_ret.mean()),
+            "eval_return_min": float(ep_ret.min()),
+            "eval_return_max": float(ep_ret.max()),
+        }

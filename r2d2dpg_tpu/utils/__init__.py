@@ -1,0 +1,12 @@
+"""Auxiliary subsystems (SURVEY.md §5): checkpointing, metrics, profiling."""
+
+from r2d2dpg_tpu.utils.checkpoint import CheckpointManager
+from r2d2dpg_tpu.utils.metrics import MetricLogger
+from r2d2dpg_tpu.utils.profiling import nan_debug, profile_trace
+
+__all__ = [
+    "CheckpointManager",
+    "MetricLogger",
+    "nan_debug",
+    "profile_trace",
+]

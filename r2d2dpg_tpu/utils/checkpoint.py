@@ -1,0 +1,129 @@
+"""Checkpoint / resume via orbax (SURVEY.md §5.4).
+
+Reference parity: the reference at most does periodic
+``torch.save(state_dict)`` with no optimizer/replay state and no resume path
+(SURVEY §5.4).  The build checkpoints the **entire** ``TrainerState`` pytree —
+params, optimizer states, target nets, RNG, replay arena (data + priorities +
+cursor), env state, episode accumulators — so a restore resumes the run
+exactly (for pure-JAX envs) or near-exactly (host-backed envs; see below).
+
+Host-backed envs (``dmc_host``): MuJoCo physics lives on the host, outside
+the pytree, so it cannot be checkpointed through this path.  On restore the
+env portion of the state is re-initialized (fresh episodes, zeroed carries);
+replay, learner and counters resume intact.  The first ``seq_len`` post-resume
+steps re-fill the window before sequences are emitted again, exactly like the
+initial warm-up — no corrupt sequences enter replay.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Periodic save + latest-restore of ``TrainerState`` under ``directory``.
+
+    A thin wrapper over ``orbax.checkpoint.CheckpointManager`` that knows how
+    to rebuild the abstract pytree template from a ``Trainer`` and to patch
+    up host-backed env state on restore.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        save_every: int = 500,
+        max_to_keep: int = 3,
+    ):
+        self.directory = directory
+        self.save_every = save_every
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    # ------------------------------------------------------------------ save
+    def maybe_save(self, phase: int, state: Any) -> bool:
+        """Save if ``phase`` hits the cadence.  Returns True when saved."""
+        if self.save_every <= 0 or phase % self.save_every != 0:
+            return False
+        self.save(phase, state)
+        return True
+
+    def save(self, step: int, state: Any) -> None:
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def wait(self) -> None:
+        """Block until async saves are durable (call before process exit)."""
+        self._mgr.wait_until_finished()
+
+    # --------------------------------------------------------------- restore
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, template: Any) -> Any:
+        """Restore the latest checkpoint into the structure of ``template``.
+
+        ``template`` is a concrete ``TrainerState`` (e.g. ``trainer.init()``)
+        — its shapes/dtypes/shardings define the restore target, so restored
+        arrays land with the same mesh layout the trainer expects.
+        """
+        step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}"
+            )
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                jnp.shape(x), x.dtype, sharding=getattr(x, "sharding", None)
+            )
+            if isinstance(x, (jax.Array, np.ndarray))
+            else x,
+            template,
+        )
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def resume_state(trainer, ckpt: CheckpointManager):
+    """``trainer.init()`` overwritten by the latest checkpoint, env-corrected.
+
+    For pure-JAX envs the restored state is returned as-is (bit-exact resume).
+    For host-backed (``batched``) envs the host physics is gone, so the env
+    slice of the state — env_state/obs/reset/carries/noise/episode_return and
+    the assembler window — is taken fresh from ``trainer.init()`` while
+    learner/replay/counters come from the checkpoint.
+    """
+    fresh = trainer.init()
+    restored = ckpt.restore(fresh)
+    if not getattr(trainer.env, "batched", False):
+        return restored
+    state = dataclasses.replace(
+        restored,
+        env_state=fresh.env_state,
+        obs=fresh.obs,
+        reset=fresh.reset,
+        actor_carry=fresh.actor_carry,
+        critic_carry=fresh.critic_carry,
+        noise_state=fresh.noise_state,
+        window=fresh.window,
+        episode_return=fresh.episode_return,
+    )
+    # The zeroed window must re-fill with real steps before any sequence is
+    # emitted, or zero-padded garbage would enter replay on the first
+    # train_phase (which emits unconditionally).  collect_phase steps the
+    # envs without emitting — exactly the initial warm-up, replayed here.
+    for _ in range(trainer.window_fill_phases):
+        state = trainer.collect_phase(state)
+    return state

@@ -1,0 +1,156 @@
+"""Metrics / logging / observability (SURVEY.md §5.5).
+
+Reference parity: the reference logs episode returns to stdout and possibly
+TensorBoard scalars (SURVEY §2.7/§5.5).  The build logs:
+
+- TensorBoard scalars (via ``tensorboardX``) when a logdir is given;
+- a CSV fallback, always (one row per log call, stable header);
+- the BASELINE metric **return @ wall-clock minutes** (every scalar is
+  stamped with both ``step`` and seconds-since-start, so return@30min is a
+  direct read-off of the CSV/TB curve);
+- **SPS** — env steps/sec and learner steps/sec — computed from deltas.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import time
+from typing import Dict, Optional
+
+
+class MetricLogger:
+    """Scalar logger: stdout + CSV (always) + TensorBoard (if logdir given).
+
+    ``log(step, scalars)`` stamps every row with wall-clock seconds since
+    construction; ``rates(env_steps, learner_steps)`` folds steps/sec deltas
+    into the next ``log`` call.
+    """
+
+    def __init__(
+        self,
+        logdir: Optional[str] = None,
+        *,
+        csv_name: str = "metrics.csv",
+        stdout: bool = True,
+        tensorboard: bool = True,
+    ):
+        self.logdir = logdir
+        self.stdout = stdout
+        self.t0 = time.monotonic()
+        self._csv_path: Optional[str] = None
+        self._csv_file = None
+        self._csv_writer = None
+        self._csv_fields: Optional[list] = None
+        self._tb = None
+        self._last_rate_t: Optional[float] = None
+        self._last_counts: Dict[str, float] = {}
+        if logdir is not None:
+            os.makedirs(logdir, exist_ok=True)
+            self._csv_path = os.path.join(logdir, csv_name)
+            if os.path.exists(self._csv_path):
+                # Resume into an existing logdir: keep the old rows and
+                # continue the wall-clock from where the previous run left
+                # off, so the return@wall-clock curve survives a restart.
+                with open(self._csv_path, newline="") as f:
+                    old = list(csv.DictReader(f))
+                if old:
+                    self._csv_fields = list(old[0].keys())
+                    try:
+                        self.t0 -= max(
+                            float(r["wall_seconds"]) for r in old
+                            if r.get("wall_seconds")
+                        )
+                    except ValueError:
+                        pass
+            if tensorboard:
+                try:
+                    from tensorboardX import SummaryWriter
+
+                    self._tb = SummaryWriter(logdir)
+                except Exception:  # pragma: no cover - tbx is installed here
+                    self._tb = None
+
+    # ------------------------------------------------------------------ rates
+    def rates(self, **counts: float) -> Dict[str, float]:
+        """Steps/sec for monotone counters since the previous ``rates`` call.
+
+        ``rates(env_steps=..., learner_steps=...)`` returns e.g.
+        ``{"env_steps_per_sec": ..., "learner_steps_per_sec": ...}``.
+        """
+        now = time.monotonic()
+        out: Dict[str, float] = {}
+        if self._last_rate_t is not None:
+            dt = max(now - self._last_rate_t, 1e-9)
+            for k, v in counts.items():
+                prev = self._last_counts.get(k)
+                if prev is not None:
+                    out[f"{k}_per_sec"] = (v - prev) / dt
+        self._last_rate_t = now
+        self._last_counts = dict(counts)
+        return out
+
+    # -------------------------------------------------------------------- log
+    def log(self, step: int, scalars: Dict[str, float]) -> None:
+        elapsed = time.monotonic() - self.t0
+        row = {"step": step, "wall_seconds": round(elapsed, 3)}
+        row.update({k: float(v) for k, v in scalars.items()})
+
+        if self.stdout:
+            body = " ".join(
+                f"{k} {v:.4g}" for k, v in row.items() if k != "step"
+            )
+            print(f"[{step}] {body}", flush=True)
+
+        if self._csv_path is not None:
+            if self._csv_writer is None or any(
+                k not in self._csv_fields for k in row
+            ):
+                self._reopen_csv(row)
+            self._csv_writer.writerow(
+                {k: row.get(k, "") for k in self._csv_fields}
+            )
+            self._csv_file.flush()
+
+        if self._tb is not None:
+            for k, v in row.items():
+                if k == "step":
+                    continue
+                self._tb.add_scalar(k, v, global_step=step, walltime=None)
+
+    def _reopen_csv(self, row: Dict[str, float]) -> None:
+        """(Re)open the CSV with a header covering all keys seen so far."""
+        old_rows = []
+        if self._csv_file is not None:
+            self._csv_file.close()
+        if os.path.exists(self._csv_path):
+            with open(self._csv_path, newline="") as f:
+                old_rows = list(csv.DictReader(f))
+        fields = list(
+            dict.fromkeys(
+                ["step", "wall_seconds"]
+                + (self._csv_fields or [])
+                + list(row)
+            )
+        )
+        self._csv_file = open(self._csv_path, "w", newline="")
+        self._csv_writer = csv.DictWriter(self._csv_file, fieldnames=fields)
+        self._csv_writer.writeheader()
+        for r in old_rows:
+            self._csv_writer.writerow({k: r.get(k, "") for k in fields})
+        self._csv_fields = fields
+
+    # ------------------------------------------------------------------ close
+    def close(self) -> None:
+        if self._csv_file is not None:
+            self._csv_file.close()
+            self._csv_file = self._csv_writer = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

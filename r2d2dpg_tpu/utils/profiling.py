@@ -1,0 +1,46 @@
+"""Tracing / profiling and numeric-debug hooks (SURVEY.md §5.1–5.2).
+
+Reference parity: the reference has no profiling or sanitizers beyond manual
+timing prints (SURVEY §5.1).  The build wires the native JAX tooling:
+
+- ``profile_trace(logdir)`` — ``jax.profiler.trace`` context manager; view
+  with TensorBoard's profile plugin (installed in this image).  Wrap a few
+  representative phases, not the whole run.
+- ``nan_debug(True)`` — flips ``jax_debug_nans`` so any NaN produced inside
+  a jitted computation raises at the op that made it (the build's answer to
+  "sanitizers": there is no shared mutable host state by design — SURVEY
+  §5.2 — so numeric poisoning is the failure mode worth a dedicated mode).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def profile_trace(
+    logdir: Optional[str], *, enabled: bool = True
+) -> Iterator[None]:
+    """Trace the enclosed block into ``logdir`` for the TB profile plugin."""
+    if not enabled or logdir is None:
+        yield
+        return
+    with jax.profiler.trace(logdir):
+        yield
+
+
+def nan_debug(enable: bool = True) -> None:
+    """Raise-at-source on NaNs inside jitted code (debug runs only: it
+
+    disables some fusions and forces extra device syncs)."""
+    jax.config.update("jax_debug_nans", enable)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Name a region so it shows up in profiler timelines."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
