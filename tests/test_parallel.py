@@ -125,3 +125,33 @@ def test_graft_entry_dryrun():
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+# ------------------------------------------------------- multi-host wrapper
+def test_distributed_initialize_noop_and_global_mesh(monkeypatch):
+    from r2d2dpg_tpu.parallel import DP_AXIS, distributed
+
+    # No cluster env, CPU backend: must be a silent no-op.
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    distributed.initialize()
+    assert jax.process_count() == 1
+    assert distributed.is_primary()
+
+    mesh = distributed.global_mesh()
+    assert mesh.shape[DP_AXIS] == len(jax.devices())
+
+
+def test_distributed_initialize_already_up_is_noop(monkeypatch):
+    from r2d2dpg_tpu.parallel import distributed
+
+    # Simulate an already-initialized multi-process runtime: must return
+    # before touching jax.distributed.initialize.
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "localhost:1234")
+
+    def boom(**kw):  # pragma: no cover - called only on regression
+        raise AssertionError("re-initialized a live distributed runtime")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    distributed.initialize()
