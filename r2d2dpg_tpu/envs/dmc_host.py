@@ -182,7 +182,12 @@ class DMCHostEnv:
         *,
         pixels: bool = False,
         camera_id: int = 0,
+        native: Optional[bool] = None,
     ):
+        """``native``: use the C++ batched pool (native/envpool) when the
+        task supports it — True forces it, False forces the Python pool,
+        None (default) auto-selects.  State obs only; pixels always use the
+        Python pool (rendering needs dm_control's EGL path)."""
         if pixels:
             os.environ.setdefault("MUJOCO_GL", "egl")
         probe = _load_dmc(domain, task, 0)
@@ -207,7 +212,30 @@ class DMCHostEnv:
             pixels=pixels,
         )
         probe.close()
-        self._pool = _HostPool(domain, task, pixels, camera_id)
+        from r2d2dpg_tpu.envs import native_pool
+
+        use_native = (
+            native_pool.is_supported(domain, task, pixels)
+            if native is None
+            else native
+        )
+        if use_native:
+            if not native_pool.is_supported(domain, task, pixels):
+                raise ValueError(
+                    f"native pool does not support {domain}-{task}"
+                    f"{' (pixels)' if pixels else ''}"
+                )
+            try:
+                self._pool = native_pool.NativeEnvPool(domain, task)
+            except Exception:
+                if native:  # explicitly requested: surface the build error
+                    raise
+                # Auto-select: fall back to the Python pool (e.g. no g++).
+                use_native = False
+                self._pool = _HostPool(domain, task, pixels, camera_id)
+        else:
+            self._pool = _HostPool(domain, task, pixels, camera_id)
+        self.native = use_native
 
     # ------------------------------------------------------------- callbacks
     def _result_shapes(self, e: int):

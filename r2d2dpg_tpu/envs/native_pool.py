@@ -1,0 +1,248 @@
+"""ctypes binding for the native C++ MuJoCo env pool (native/envpool).
+
+Reference parity: the reference's experience collection is N Python actor
+processes each stepping dm_control through its Python layer (SURVEY.md §2.3).
+Here the whole fleet is one C++ shared library — a persistent worker-thread
+pool stepping E ``mjData`` instances over one shared ``mjModel``, with task
+observation/reward/reset logic in C++ — so a *batch* env step is a single
+ctypes call with zero Python in the per-env path.  ``DMCHostEnv`` uses this
+as its fast path (state observations); the Python dm_control pool remains
+the fallback for pixels and tasks outside the supported set.
+
+The shared library is built on demand from ``native/Makefile`` (g++ against
+the mujoco wheel's bundled libmujoco); the build is cached next to the
+sources in ``native/build/``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+# (domain, task) -> TaskId in native/envpool/env_pool.cc.
+NATIVE_TASKS = {
+    ("walker", "stand"): 0,
+    ("walker", "walk"): 1,
+    ("walker", "run"): 2,
+    ("cheetah", "run"): 3,
+    ("humanoid", "stand"): 4,
+    ("humanoid", "walk"): 5,
+    ("humanoid", "run"): 6,
+}
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libenvpool.so")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _suite_xml(domain: str) -> str:
+    from dm_control.suite import common  # noqa: F401  (locates the suite dir)
+    import dm_control.suite as suite_pkg
+
+    return os.path.join(os.path.dirname(suite_pkg.__file__), f"{domain}.xml")
+
+
+def _build_lib() -> None:
+    result = subprocess.run(
+        ["make", "-C", _NATIVE_DIR],
+        capture_output=True,
+        text=True,
+    )
+    if result.returncode != 0:
+        raise RuntimeError(
+            f"native env-pool build failed (make -C {_NATIVE_DIR}):\n"
+            f"{result.stdout}\n{result.stderr}"
+        )
+
+
+def load_library() -> ctypes.CDLL:
+    """Load (building if necessary) the env-pool shared library.
+
+    ``make`` runs unconditionally — it no-ops when the .so is fresh and
+    rebuilds when env_pool.cc changed, so a stale binary can't shadow
+    source edits.
+    """
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        _build_lib()
+        lib = ctypes.CDLL(_LIB_PATH)
+        c_float_p = ctypes.POINTER(ctypes.c_float)
+        c_double_p = ctypes.POINTER(ctypes.c_double)
+        c_int64_p = ctypes.POINTER(ctypes.c_int64)
+        lib.envpool_create.restype = ctypes.c_void_p
+        lib.envpool_create.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            c_int64_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.envpool_destroy.argtypes = [ctypes.c_void_p]
+        for name in ("obs_dim", "action_dim", "episode_len", "nq", "nv"):
+            fn = getattr(lib, f"envpool_{name}")
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p]
+        lib.envpool_seed.argtypes = [ctypes.c_void_p, c_int64_p]
+        lib.envpool_reset_all.argtypes = [ctypes.c_void_p] + [c_float_p] * 4
+        lib.envpool_step.argtypes = [ctypes.c_void_p, c_float_p] + [c_float_p] * 4
+        lib.envpool_get_state.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            c_double_p,
+            c_double_p,
+        ]
+        lib.envpool_set_state.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            c_double_p,
+            c_double_p,
+            c_double_p,
+        ]
+        lib.envpool_reward_of.restype = ctypes.c_double
+        lib.envpool_reward_of.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.envpool_obs_of.argtypes = [ctypes.c_void_p, ctypes.c_int, c_float_p]
+        _lib = lib
+        return lib
+
+
+def is_supported(domain: str, task: str, pixels: bool) -> bool:
+    return not pixels and (domain, task) in NATIVE_TASKS
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _dptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+class NativeEnvPool:
+    """Drop-in replacement for the Python ``_HostPool`` (state obs only).
+
+    Same batched contract: ``reset_all(seeds)`` / ``step_all(actions)``
+    return ``(obs, reward, discount, reset)`` float32 arrays; episode ends
+    auto-reset with the fresh obs flagged ``reset=1``.
+    """
+
+    def __init__(self, domain: str, task: str, num_threads: int = 0):
+        if (domain, task) not in NATIVE_TASKS:
+            raise ValueError(f"no native task for {domain}-{task}")
+        self.domain, self.task = domain, task
+        self._task_id = NATIVE_TASKS[(domain, task)]
+        self._num_threads = num_threads
+        self._lib = load_library()
+        self._handle: Optional[int] = None
+        self._num_envs = 0
+
+    # ------------------------------------------------------------- lifecycle
+    def _create(self, seeds: np.ndarray) -> None:
+        self.close()
+        err = ctypes.create_string_buffer(512)
+        seeds64 = np.ascontiguousarray(seeds, np.int64)
+        handle = self._lib.envpool_create(
+            _suite_xml(self.domain).encode(),
+            self._task_id,
+            len(seeds64),
+            self._num_threads,
+            seeds64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            err,
+            len(err),
+        )
+        if not handle:
+            raise RuntimeError(f"envpool_create: {err.value.decode()}")
+        self._handle = handle
+        self._num_envs = len(seeds64)
+        self.obs_dim = self._lib.envpool_obs_dim(handle)
+        self.action_dim = self._lib.envpool_action_dim(handle)
+        self.episode_len = self._lib.envpool_episode_len(handle)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.envpool_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ batch API
+    def reset_all(self, seeds: np.ndarray):
+        seeds = np.asarray(seeds)
+        if self._handle is None or len(seeds) != self._num_envs:
+            self._create(seeds)
+        else:
+            seeds64 = np.ascontiguousarray(seeds, np.int64)
+            self._lib.envpool_seed(
+                self._handle,
+                seeds64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            )
+        e = self._num_envs
+        obs = np.empty((e, self.obs_dim), np.float32)
+        reward = np.empty((e,), np.float32)
+        discount = np.empty((e,), np.float32)
+        reset = np.empty((e,), np.float32)
+        self._lib.envpool_reset_all(
+            self._handle, _fptr(obs), _fptr(reward), _fptr(discount), _fptr(reset)
+        )
+        return obs, reward, discount, reset
+
+    def step_all(self, actions: np.ndarray):
+        assert self._handle is not None, "reset_all must run first"
+        e = self._num_envs
+        actions = np.ascontiguousarray(actions, np.float32)
+        assert actions.shape == (e, self.action_dim), actions.shape
+        obs = np.empty((e, self.obs_dim), np.float32)
+        reward = np.empty((e,), np.float32)
+        discount = np.empty((e,), np.float32)
+        reset = np.empty((e,), np.float32)
+        self._lib.envpool_step(
+            self._handle,
+            _fptr(actions),
+            _fptr(obs),
+            _fptr(reward),
+            _fptr(discount),
+            _fptr(reset),
+        )
+        return obs, reward, discount, reset
+
+    # ---------------------------------------------------------- test hooks
+    def get_state(self, i: int) -> Tuple[np.ndarray, np.ndarray]:
+        nq = self._lib.envpool_nq(self._handle)
+        nv = self._lib.envpool_nv(self._handle)
+        qpos = np.empty((nq,), np.float64)
+        qvel = np.empty((nv,), np.float64)
+        self._lib.envpool_get_state(self._handle, i, _dptr(qpos), _dptr(qvel))
+        return qpos, qvel
+
+    def set_state(self, i: int, qpos, qvel, qacc_warmstart=None) -> None:
+        qpos = np.ascontiguousarray(qpos, np.float64)
+        qvel = np.ascontiguousarray(qvel, np.float64)
+        ws = (
+            _dptr(np.ascontiguousarray(qacc_warmstart, np.float64))
+            if qacc_warmstart is not None
+            else ctypes.POINTER(ctypes.c_double)()
+        )
+        self._lib.envpool_set_state(self._handle, i, _dptr(qpos), _dptr(qvel), ws)
+
+    def reward_of(self, i: int) -> float:
+        return float(self._lib.envpool_reward_of(self._handle, i))
+
+    def obs_of(self, i: int) -> np.ndarray:
+        obs = np.empty((self.obs_dim,), np.float32)
+        self._lib.envpool_obs_of(self._handle, i, _fptr(obs))
+        return obs

@@ -58,14 +58,24 @@ def initialize(
     if process_id is None and "JAX_PROCESS_ID" in os.environ:
         process_id = int(os.environ["JAX_PROCESS_ID"])
 
-    on_tpu_pod = jax.default_backend() == "tpu" and (
-        "TPU_WORKER_HOSTNAMES" in os.environ or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
+    # IMPORTANT: jax.distributed.initialize() must run before anything
+    # touches the local XLA backend, so cluster detection here reads only
+    # environment variables — never jax.default_backend()/process_count().
+    # TPU_WORKER_HOSTNAMES is set even on single-host boxes (e.g.
+    # 'localhost'); only >1 comma-separated workers means a pod.
+    workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    on_tpu_pod = (
+        len([w for w in workers.split(",") if w.strip()]) > 1
+        or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
     )
     if coordinator_address is None and not on_tpu_pod:
         return  # single-host: nothing to bring up
 
-    if jax.process_count() > 1:
-        return  # already initialized
+    already_up = (
+        getattr(jax._src.distributed.global_state, "client", None) is not None
+    )
+    if already_up:
+        return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -79,7 +89,9 @@ def global_mesh() -> jax.sharding.Mesh:
     ``jax.devices()`` already enumerates the global device set once the
     distributed runtime is up; locally it degrades to the local mesh.
     """
-    return jax.make_mesh((len(jax.devices()),), (DP_AXIS,))
+    from r2d2dpg_tpu.parallel.mesh import make_mesh
+
+    return make_mesh()
 
 
 def is_primary() -> bool:

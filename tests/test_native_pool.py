@@ -1,0 +1,145 @@
+"""Native C++ env pool vs dm_control: trajectory-level parity.
+
+The native pool (native/envpool/env_pool.cc) reimplements the suite tasks'
+step/observation/reward logic against the MuJoCo C API.  These tests sync a
+dm_control env's exact state (qpos, qvel, qacc_warmstart) into a native env
+and drive both with identical action sequences: observations and rewards
+must match to float32 round-off at every step, since both run the same
+libmujoco with dm_control's legacy-step call sequence.
+"""
+
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.envs import native_pool
+
+pytestmark = pytest.mark.slow
+
+
+def _dmc_env(domain, task, seed=0):
+    from dm_control import suite
+
+    return suite.load(domain, task, task_kwargs={"random": seed})
+
+
+def _flat(obs_dict):
+    return np.concatenate(
+        [np.asarray(v, np.float64).reshape(-1) for v in obs_dict.values()]
+    )
+
+
+def _sync_and_rollout(domain, task, steps, seed=0):
+    """Returns (dmc_obs, dmc_rew, nat_obs, nat_rew) over a shared rollout."""
+    env = _dmc_env(domain, task, seed)
+    ts = env.reset()
+    pool = native_pool.NativeEnvPool(domain, task, num_threads=1)
+    pool.reset_all(np.asarray([seed]))
+    pool.set_state(
+        0,
+        env.physics.data.qpos.copy(),
+        env.physics.data.qvel.copy(),
+        env.physics.data.qacc_warmstart.copy(),
+    )
+
+    spec = env.action_spec()
+    rng = np.random.RandomState(seed + 1)
+    dmc_obs, dmc_rew, nat_obs, nat_rew = [], [], [], []
+    # First obs must already agree after the state sync.
+    np.testing.assert_allclose(
+        pool.obs_of(0), _flat(ts.observation).astype(np.float32), rtol=0, atol=0
+    )
+    for _ in range(steps):
+        a = rng.uniform(spec.minimum, spec.maximum, spec.shape).astype(np.float32)
+        ts = env.step(a)
+        obs, rew, _, reset = pool.step_all(a[None])
+        assert reset[0] == 0.0
+        dmc_obs.append(_flat(ts.observation))
+        dmc_rew.append(ts.reward)
+        nat_obs.append(obs[0])
+        nat_rew.append(rew[0])
+    return (
+        np.asarray(dmc_obs),
+        np.asarray(dmc_rew),
+        np.asarray(nat_obs),
+        np.asarray(nat_rew),
+    )
+
+
+@pytest.mark.parametrize(
+    "domain,task",
+    [("walker", "walk"), ("cheetah", "run"), ("humanoid", "run")],
+)
+def test_trajectory_parity(domain, task):
+    dmc_obs, dmc_rew, nat_obs, nat_rew = _sync_and_rollout(domain, task, steps=50)
+    # Same libmujoco, same call sequence: float32 cast is the only noise.
+    np.testing.assert_allclose(
+        nat_obs, dmc_obs.astype(np.float32), rtol=1e-6, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        nat_rew, dmc_rew.astype(np.float32), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_episode_limit_and_autoreset():
+    pool = native_pool.NativeEnvPool("cheetah", "run", num_threads=1)
+    obs0, _, _, reset0 = pool.reset_all(np.asarray([7]))
+    assert reset0[0] == 1.0
+    a = np.zeros((1, pool.action_dim), np.float32)
+    for t in range(pool.episode_len):
+        obs, _, discount, reset = pool.step_all(a)
+        expected = 1.0 if t == pool.episode_len - 1 else 0.0
+        assert reset[0] == expected, t
+        assert discount[0] == 1.0  # suite tasks never terminate early
+    # After auto-reset the next episode runs from a fresh randomized state.
+    obs2, _, _, reset2 = pool.step_all(a)
+    assert reset2[0] == 0.0
+
+
+def test_reset_distribution_matches_dmc_rules():
+    """Walker resets follow randomize_limited_and_rotational_joints rules:
+    limited hinges uniform in range, the unlimited rooty hinge in [-pi, pi],
+    slides untouched (= model default 0 for rootx; rootz stays at qpos0)."""
+    import mujoco
+
+    pool = native_pool.NativeEnvPool("walker", "walk", num_threads=1)
+    pool.reset_all(np.arange(64))
+    model = mujoco.MjModel.from_xml_path(native_pool._suite_xml("walker"))
+    qpos0 = model.qpos0.copy()
+    rooty_vals, limited_ok = [], True
+    for i in range(64):
+        qpos, _ = pool.get_state(i)
+        for j in range(model.njnt):
+            adr = model.jnt_qposadr[j]
+            lo, hi = model.jnt_range[j]
+            if model.jnt_limited[j]:
+                limited_ok &= lo - 1e-9 <= qpos[adr] <= hi + 1e-9
+            elif model.jnt_type[j] == mujoco.mjtJoint.mjJNT_HINGE:
+                rooty_vals.append(qpos[adr])
+            elif model.jnt_type[j] == mujoco.mjtJoint.mjJNT_SLIDE:
+                assert qpos[adr] == qpos0[adr]
+    assert limited_ok
+    rooty = np.asarray(rooty_vals)
+    assert rooty.min() < -1.0 and rooty.max() > 1.0  # spans [-pi, pi]
+    assert np.abs(rooty).max() <= np.pi + 1e-9
+
+
+def test_humanoid_reset_is_collision_free():
+    pool = native_pool.NativeEnvPool("humanoid", "run", num_threads=1)
+    obs, _, _, _ = pool.reset_all(np.arange(8))
+    assert np.isfinite(obs).all()
+    assert pool.obs_dim == 67
+
+
+def test_threaded_pool_matches_serial():
+    serial = native_pool.NativeEnvPool("walker", "walk", num_threads=1)
+    threaded = native_pool.NativeEnvPool("walker", "walk", num_threads=4)
+    so = serial.reset_all(np.arange(8))[0]
+    to = threaded.reset_all(np.arange(8))[0]
+    np.testing.assert_array_equal(so, to)
+    rng = np.random.RandomState(0)
+    for _ in range(10):
+        a = rng.uniform(-1, 1, (8, serial.action_dim)).astype(np.float32)
+        so = serial.step_all(a)
+        to = threaded.step_all(a)
+        for s, t in zip(so, to):
+            np.testing.assert_array_equal(s, t)

@@ -147,11 +147,29 @@ def test_distributed_initialize_already_up_is_noop(monkeypatch):
 
     # Simulate an already-initialized multi-process runtime: must return
     # before touching jax.distributed.initialize.
-    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    monkeypatch.setattr(
+        jax._src.distributed.global_state, "client", object(), raising=False
+    )
     monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "localhost:1234")
 
     def boom(**kw):  # pragma: no cover - called only on regression
         raise AssertionError("re-initialized a live distributed runtime")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    distributed.initialize()
+
+
+def test_distributed_single_host_tpu_worker_hostnames_is_noop(monkeypatch):
+    # The axon plugin exports TPU_WORKER_HOSTNAMES=localhost even on a
+    # single-host box; a single worker must not trigger pod bring-up.
+    from r2d2dpg_tpu.parallel import distributed
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+
+    def boom(**kw):  # pragma: no cover - called only on regression
+        raise AssertionError("brought up distributed runtime on single host")
 
     monkeypatch.setattr(jax.distributed, "initialize", boom)
     distributed.initialize()
