@@ -385,19 +385,28 @@ struct StepOut {
   float* reset;
 };
 
-void StepEnv(Pool* p, int i, const float* actions, const StepOut& out) {
+void StepEnv(Pool* p, int i, const float* actions, int repeat,
+             const StepOut& out) {
   EnvSlot& e = p->envs[i];
   const mjModel* m = p->model;
   mjData* d = e.data;
   const float* act = actions + static_cast<int64_t>(i) * m->nu;
-  for (int u = 0; u < m->nu; ++u) d->ctrl[u] = static_cast<double>(act[u]);
-  LegacyStep(m, d, p->nsub);
-  e.step_count += 1;
-  const double reward = ComputeReward(p, i);
-  // Suite walker/cheetah/humanoid tasks never terminate early
-  // (get_termination is always None): discount is 1 and episodes end only
-  // at the step limit, where the env auto-resets and flags the fresh obs.
-  const bool last = e.step_count >= p->step_limit;
+  // Action repeat: apply the same control for `repeat` control steps,
+  // summing rewards (the DM-Control wrapper convention — episode return
+  // keeps its 0..1000 scale), stopping at the episode boundary so a fresh
+  // episode never sees the stale action.
+  double reward = 0.0;
+  bool last = false;
+  for (int r = 0; r < repeat && !last; ++r) {
+    for (int u = 0; u < m->nu; ++u) d->ctrl[u] = static_cast<double>(act[u]);
+    LegacyStep(m, d, p->nsub);
+    e.step_count += 1;
+    reward += ComputeReward(p, i);
+    // Suite walker/cheetah/humanoid tasks never terminate early
+    // (get_termination is always None): discount is 1 and episodes end only
+    // at the step limit, where the env auto-resets and flags the fresh obs.
+    last = e.step_count >= p->step_limit;
+  }
   if (last) ResetEnv(p, i);
   WriteObs(p, i, out.obs + static_cast<int64_t>(i) * p->obs_dim);
   out.reward[i] = static_cast<float>(reward);
@@ -532,11 +541,12 @@ void envpool_reset_all(void* h, float* obs, float* reward, float* discount,
   }
 }
 
-void envpool_step(void* h, const float* actions, float* obs, float* reward,
-                  float* discount, float* reset) {
+void envpool_step(void* h, const float* actions, int repeat, float* obs,
+                  float* reward, float* discount, float* reset) {
   Pool* p = static_cast<Pool*>(h);
   const StepOut out{obs, reward, discount, reset};
-  p->RunBatch([p, actions, &out](int i) { StepEnv(p, i, actions, out); });
+  p->RunBatch(
+      [p, actions, repeat, &out](int i) { StepEnv(p, i, actions, repeat, out); });
 }
 
 // --------------------------- test hooks (state sync for parity checks)

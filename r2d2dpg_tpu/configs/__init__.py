@@ -73,11 +73,13 @@ def _pendulum():
     return Pendulum()
 
 
-def _dmc(domain: str, task: str, pixels: bool = False):
+def _dmc(domain: str, task: str, pixels: bool = False, action_repeat: int = 1):
     def factory():
         from r2d2dpg_tpu.envs.dmc_host import DMCHostEnv
 
-        return DMCHostEnv(domain, task, pixels=pixels)
+        return DMCHostEnv(
+            domain, task, pixels=pixels, action_repeat=action_repeat
+        )
 
     return factory
 
@@ -142,7 +144,7 @@ PENDULUM_R2D2 = ExperimentConfig(
 # 3: the north-star metric config (walker-walk @ 30 min).
 WALKER_R2D2 = ExperimentConfig(
     name="walker_r2d2",
-    env_factory=_dmc("walker", "walk"),
+    env_factory=_dmc("walker", "walk", action_repeat=2),
     use_lstm=True,
     agent=AgentConfig(
         burnin=20,
@@ -169,7 +171,7 @@ WALKER_R2D2 = ExperimentConfig(
 # 4: long sequences (seq-len 80) at 256 actors.
 HUMANOID_R2D2 = ExperimentConfig(
     name="humanoid_r2d2",
-    env_factory=_dmc("humanoid", "run"),
+    env_factory=_dmc("humanoid", "run", action_repeat=2),
     use_lstm=True,
     agent=AgentConfig(
         burnin=40,
@@ -196,7 +198,7 @@ HUMANOID_R2D2 = ExperimentConfig(
 # 5: from-pixels (CNN+LSTM encoder).
 CHEETAH_PIXELS = ExperimentConfig(
     name="cheetah_pixels",
-    env_factory=_dmc("cheetah", "run", pixels=True),
+    env_factory=_dmc("cheetah", "run", pixels=True, action_repeat=4),
     use_lstm=True,
     pixels=True,
     agent=AgentConfig(

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import atexit
 import dataclasses
+import functools
 import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Tuple
@@ -130,23 +131,24 @@ class _HostPool:
             np.ones((e,), np.float32),
         )
 
-    def step_all(self, actions: np.ndarray):
+    def step_all(self, actions: np.ndarray, repeat: int = 1):
         def step_one(i):
             env = self.envs[i]
-            dm_ts = env.step(actions[i])
-            if dm_ts.last():
-                reward = np.float32(dm_ts.reward or 0.0)
-                discount = np.float32(
+            # Action repeat: same control for `repeat` dm steps, rewards
+            # summed, stopping at the episode boundary (wrapper convention —
+            # keeps the suite's 0..1000 episode-return scale).
+            reward = np.float32(0.0)
+            discount = np.float32(1.0)
+            for _ in range(repeat):
+                dm_ts = env.step(actions[i])
+                reward += np.float32(dm_ts.reward or 0.0)
+                discount *= np.float32(
                     1.0 if dm_ts.discount is None else dm_ts.discount
                 )
-                fresh = env.reset()
-                return fresh, reward, discount, np.float32(1.0)
-            return (
-                dm_ts,
-                np.float32(dm_ts.reward or 0.0),
-                np.float32(1.0 if dm_ts.discount is None else dm_ts.discount),
-                np.float32(0.0),
-            )
+                if dm_ts.last():
+                    fresh = env.reset()
+                    return fresh, reward, discount, np.float32(1.0)
+            return dm_ts, reward, discount, np.float32(0.0)
 
         results = list(self.executor.map(step_one, range(len(self.envs))))
         # Renders (pixels) happen here, serially, on the callback thread.
@@ -183,11 +185,20 @@ class DMCHostEnv:
         pixels: bool = False,
         camera_id: int = 0,
         native: Optional[bool] = None,
+        action_repeat: int = 1,
     ):
         """``native``: use the C++ batched pool (native/envpool) when the
         task supports it — True forces it, False forces the Python pool,
         None (default) auto-selects.  State obs only; pixels always use the
-        Python pool (rendering needs dm_control's EGL path)."""
+        Python pool (rendering needs dm_control's EGL path).
+
+        ``action_repeat``: apply each policy action for this many control
+        steps (rewards summed, boundary-safe) — the standard DM-Control
+        benchmark wrapper.  On TPU it also divides the host-callback count
+        per collected agent step by the repeat factor."""
+        if action_repeat < 1:
+            raise ValueError(f"action_repeat must be >= 1, got {action_repeat}")
+        self.action_repeat = action_repeat
         if pixels:
             os.environ.setdefault("MUJOCO_GL", "egl")
         probe = _load_dmc(domain, task, 0)
@@ -202,13 +213,15 @@ class DMCHostEnv:
             obs_shape = _flatten_obs(ts0.observation).shape
             self._obs_dtype = jnp.float32
         limit = getattr(probe, "_step_limit", 1000)
+        limit = int(limit) if np.isfinite(limit) else 1000
         self.spec = EnvSpec(
             name=f"{domain}-{task}" + ("-pixels" if pixels else ""),
             obs_shape=obs_shape,
             action_dim=int(np.prod(action_spec.shape)),
             action_min=float(self._act_min.min()),
             action_max=float(self._act_max.max()),
-            episode_length=int(limit) if np.isfinite(limit) else 1000,
+            # Agent-visible horizon: control steps / action_repeat.
+            episode_length=-(-limit // action_repeat),
             pixels=pixels,
         )
         probe.close()
@@ -267,7 +280,10 @@ class DMCHostEnv:
         scaled = scaled + 0.0 * state.token.astype(scaled.dtype)
         e = actions.shape[0]
         obs, reward, discount, reset = io_callback(
-            self._pool.step_all, self._result_shapes(e), scaled, ordered=True
+            functools.partial(self._pool.step_all, repeat=self.action_repeat),
+            self._result_shapes(e),
+            scaled,
+            ordered=True,
         )
         ts = TimeStep(obs=obs, reward=reward, discount=discount, reset=reset)
         return DMCState(token=state.token + 1), ts

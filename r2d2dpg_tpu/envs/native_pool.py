@@ -96,7 +96,9 @@ def load_library() -> ctypes.CDLL:
             fn.argtypes = [ctypes.c_void_p]
         lib.envpool_seed.argtypes = [ctypes.c_void_p, c_int64_p]
         lib.envpool_reset_all.argtypes = [ctypes.c_void_p] + [c_float_p] * 4
-        lib.envpool_step.argtypes = [ctypes.c_void_p, c_float_p] + [c_float_p] * 4
+        lib.envpool_step.argtypes = (
+            [ctypes.c_void_p, c_float_p, ctypes.c_int] + [c_float_p] * 4
+        )
         lib.envpool_get_state.argtypes = [
             ctypes.c_void_p,
             ctypes.c_int,
@@ -201,8 +203,10 @@ class NativeEnvPool:
         )
         return obs, reward, discount, reset
 
-    def step_all(self, actions: np.ndarray):
+    def step_all(self, actions: np.ndarray, repeat: int = 1):
         assert self._handle is not None, "reset_all must run first"
+        if repeat < 1:
+            raise ValueError(f"repeat must be >= 1, got {repeat}")
         e = self._num_envs
         actions = np.ascontiguousarray(actions, np.float32)
         assert actions.shape == (e, self.action_dim), actions.shape
@@ -213,6 +217,7 @@ class NativeEnvPool:
         self._lib.envpool_step(
             self._handle,
             _fptr(actions),
+            int(repeat),
             _fptr(obs),
             _fptr(reward),
             _fptr(discount),

@@ -143,3 +143,41 @@ def test_threaded_pool_matches_serial():
         to = threaded.step_all(a)
         for s, t in zip(so, to):
             np.testing.assert_array_equal(s, t)
+
+
+def test_action_repeat_matches_serial_steps():
+    """step_all(a, repeat=k) == k serial step_all(a) calls: same final state
+    and obs, rewards summed."""
+    single = native_pool.NativeEnvPool("walker", "walk", num_threads=1)
+    repeated = native_pool.NativeEnvPool("walker", "walk", num_threads=1)
+    single.reset_all(np.asarray([3, 4]))
+    repeated.reset_all(np.asarray([3, 4]))
+    rng = np.random.RandomState(1)
+    for _ in range(5):
+        a = rng.uniform(-1, 1, (2, single.action_dim)).astype(np.float32)
+        rew_sum = np.zeros(2, np.float32)
+        for _ in range(4):
+            so, sr, _, s_reset = single.step_all(a)
+            rew_sum += sr
+            assert (s_reset == 0).all()
+        ro, rr, _, r_reset = repeated.step_all(a, repeat=4)
+        np.testing.assert_array_equal(ro, so)
+        np.testing.assert_allclose(rr, rew_sum, rtol=1e-6)
+        assert (r_reset == 0).all()
+
+
+def test_action_repeat_stops_at_episode_boundary():
+    """A repeat block straddling the step limit ends the episode exactly at
+    the limit (no leakage of the stale action into the fresh episode)."""
+    pool = native_pool.NativeEnvPool("cheetah", "run", num_threads=1)
+    pool.reset_all(np.asarray([11]))
+    a = np.zeros((1, pool.action_dim), np.float32)
+    # Walk to 3 steps before the limit, then request repeat=5.
+    for _ in range(pool.episode_len - 3):
+        _, _, _, reset = pool.step_all(a)
+        assert reset[0] == 0.0
+    _, _, _, reset = pool.step_all(a, repeat=5)
+    assert reset[0] == 1.0  # stopped at the boundary (3 steps), auto-reset
+    # The fresh episode is at step 0: it should survive a full repeat block.
+    _, _, _, reset = pool.step_all(a, repeat=5)
+    assert reset[0] == 0.0
