@@ -15,12 +15,19 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 
 def main() -> None:
     import jax
     import jax.numpy as jnp
+
+    # Optional activation-dtype override for experiments:
+    #   python bench.py bfloat16
+    # The recorded metric (driver runs with no args) stays the shipped
+    # default (float32 activations).
+    dtype = jnp.dtype(sys.argv[1]) if len(sys.argv) > 1 else jnp.float32
 
     from r2d2dpg_tpu.agents import AgentConfig, R2D2DPG
     from r2d2dpg_tpu.models import ActorNet, CriticNet
@@ -33,8 +40,8 @@ def main() -> None:
     seq_len = cfg.seq_len
     capacity = 100_000
 
-    actor = ActorNet(action_dim=act_dim, hidden=hidden, use_lstm=True)
-    critic = CriticNet(hidden=hidden, use_lstm=True)
+    actor = ActorNet(action_dim=act_dim, hidden=hidden, use_lstm=True, dtype=dtype)
+    critic = CriticNet(hidden=hidden, use_lstm=True, dtype=dtype)
     agent = R2D2DPG(actor, critic, cfg)
 
     key = jax.random.PRNGKey(0)

@@ -35,6 +35,10 @@ class ExperimentConfig:
     use_lstm: bool = True
     pixels: bool = False
     hidden: int = 256
+    # Activation/compute dtype for the nets ("float32" | "bfloat16").
+    # Params, optimizer state, and losses stay float32 (flax mixed
+    # precision); bfloat16 halves HBM traffic and doubles MXU rate.
+    compute_dtype: str = "float32"
 
     def build(self) -> Trainer:
         env = self.env_factory()
@@ -42,14 +46,21 @@ class ExperimentConfig:
         return Trainer(env, agent, self.trainer)
 
     def build_agent(self, env: Environment, axis_name=None) -> R2D2DPG:
+        import jax.numpy as jnp
+
+        dtype = jnp.dtype(self.compute_dtype)
         actor = ActorNet(
             action_dim=env.spec.action_dim,
             hidden=self.hidden,
             use_lstm=self.use_lstm,
             pixels=self.pixels,
+            dtype=dtype,
         )
         critic = CriticNet(
-            hidden=self.hidden, use_lstm=self.use_lstm, pixels=self.pixels
+            hidden=self.hidden,
+            use_lstm=self.use_lstm,
+            pixels=self.pixels,
+            dtype=dtype,
         )
         agent_cfg = (
             dataclasses.replace(self.agent, axis_name=axis_name)

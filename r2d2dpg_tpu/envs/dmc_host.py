@@ -132,6 +132,9 @@ class _HostPool:
         )
 
     def step_all(self, actions: np.ndarray, repeat: int = 1):
+        if repeat < 1:
+            raise ValueError(f"repeat must be >= 1, got {repeat}")
+
         def step_one(i):
             env = self.envs[i]
             # Action repeat: same control for `repeat` dm steps, rewards

@@ -43,6 +43,10 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--param-sync-every", type=int, default=None,
         help="refresh behavior params every K phases (0 = always fresh)"
     )
+    p.add_argument(
+        "--compute-dtype", default=None, choices=["float32", "bfloat16"],
+        help="net activation dtype (params/optimizer stay float32)"
+    )
     # SPMD.
     p.add_argument(
         "--spmd", type=int, default=0, metavar="D",
@@ -78,6 +82,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         cfg = dataclasses.replace(
             cfg, trainer=dataclasses.replace(cfg.trainer, **t)
         )
+    if args.compute_dtype is not None:
+        cfg = dataclasses.replace(cfg, compute_dtype=args.compute_dtype)
     return cfg
 
 

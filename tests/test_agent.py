@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from r2d2dpg_tpu.agents import AgentConfig, R2D2DPG
+from r2d2dpg_tpu.agents.ddpg import TrainState
 from r2d2dpg_tpu.models import ActorNet, CriticNet
 from r2d2dpg_tpu.replay.arena import SequenceBatch
 
@@ -167,3 +168,51 @@ def test_initial_priority_matches_learner_td():
     np.testing.assert_allclose(
         np.asarray(p_init), np.asarray(p_learn), rtol=1e-4, atol=1e-5
     )
+
+
+def test_fused_burnin_matches_unfused():
+    """The stacked-params fused burn-in must produce the same warmed carries
+    (and hence the same learner step) as four separate unrolls."""
+    fused = make_agent(use_lstm=True, burnin=4, fused_burnin=True)
+    plain = make_agent(use_lstm=True, burnin=4, fused_burnin=False)
+    batch = make_batch(fused, key=3)
+    # Non-trivial stored carries + a mid-burnin reset row.
+    h = jax.random.normal(jax.random.PRNGKey(9), (B, HID))
+    batch = SequenceBatch(
+        obs=batch.obs,
+        action=batch.action,
+        reward=batch.reward,
+        discount=batch.discount,
+        reset=batch.reset.at[1, 2].set(1.0),
+        carries={"actor": (h, 0.5 * h), "critic": (-h, 0.25 * h)},
+    )
+    state = fused.init(jax.random.PRNGKey(0), batch.obs[:, 0], batch.action[:, 0])
+    # Desync targets from online so fused/unfused disagreement would show.
+    state = TrainState(
+        actor_params=state.actor_params,
+        critic_params=state.critic_params,
+        target_actor_params=jax.tree_util.tree_map(
+            lambda x: x + 0.1, state.actor_params
+        ),
+        target_critic_params=jax.tree_util.tree_map(
+            lambda x: x - 0.1, state.critic_params
+        ),
+        actor_opt_state=state.actor_opt_state,
+        critic_opt_state=state.critic_opt_state,
+        step=state.step,
+    )
+    got = fused._burn_in(state, batch)
+    want = plain._burn_in(state, batch)
+    for g, w in zip(got, want):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+            g,
+            w,
+        )
+    # And the full learner step agrees.
+    w_is = jnp.ones((B,))
+    _, p_f, m_f = fused.learner_step(state, batch, w_is)
+    _, p_p, m_p = plain.learner_step(state, batch, w_is)
+    np.testing.assert_allclose(np.asarray(p_f), np.asarray(p_p), rtol=1e-5)
+    for k in m_f:
+        np.testing.assert_allclose(float(m_f[k]), float(m_p[k]), rtol=1e-4)
