@@ -127,3 +127,20 @@ def test_dmc_host_env_action_repeat():
         # so a 2-step sum lands in (0, 2]).
         assert 0.0 < nr[0] <= 2.0
         assert 0.0 < pr[0] <= 2.0
+
+
+@pytest.mark.slow
+def test_dmc_host_env_pixels():
+    """Config-#5 path: 64x64x3 uint8 EGL renders through the host pool."""
+    from r2d2dpg_tpu.envs import DMCHostEnv
+
+    env = DMCHostEnv("cheetah", "run", pixels=True, action_repeat=4)
+    assert env.spec.obs_shape == (64, 64, 3)
+    assert env.spec.pixels
+    assert env.spec.episode_length == 250  # 1000 control steps / repeat 4
+    state, ts = env.reset(jax.random.PRNGKey(0), 2)
+    assert ts.obs.shape == (2, 64, 64, 3) and ts.obs.dtype == jnp.uint8
+    state, ts2 = env.step(state, jnp.zeros((2, 6)), jax.random.PRNGKey(1))
+    assert ts2.obs.shape == (2, 64, 64, 3)
+    # Renders are real images, not constant fills.
+    assert int(np.asarray(ts2.obs).std()) > 0
