@@ -1,5 +1,14 @@
 """Environments (SURVEY.md §2.6): pure-JAX on-device + host-callback pools."""
 
+import os
+
+# dm_control chooses its GL backend once, at import time.  Any entry point
+# in this package may be the first to import dm_control (env construction,
+# the native pool's asset lookup, tests in any order), so pin the headless
+# EGL backend here — before a pixels config needs to render — unless the
+# user chose one explicitly.
+os.environ.setdefault("MUJOCO_GL", "egl")
+
 from r2d2dpg_tpu.envs.core import Environment, EnvSpec, EnvState, TimeStep
 from r2d2dpg_tpu.envs.dmc_host import DMCHostEnv
 from r2d2dpg_tpu.envs.pendulum import Pendulum

@@ -202,8 +202,9 @@ class DMCHostEnv:
         if action_repeat < 1:
             raise ValueError(f"action_repeat must be >= 1, got {action_repeat}")
         self.action_repeat = action_repeat
-        if pixels:
-            os.environ.setdefault("MUJOCO_GL", "egl")
+        # MUJOCO_GL=egl is pinned in r2d2dpg_tpu.envs.__init__ (dm_control
+        # picks its GL backend at first import, which any entry point may
+        # trigger before a pixels env exists).
         probe = _load_dmc(domain, task, 0)
         action_spec = probe.action_spec()
         self._act_min = np.asarray(action_spec.minimum, np.float32)
