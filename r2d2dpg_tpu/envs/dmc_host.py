@@ -36,7 +36,6 @@ from __future__ import annotations
 import atexit
 import dataclasses
 import functools
-import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Tuple
 
