@@ -70,10 +70,15 @@ class ExperimentConfig:
         return R2D2DPG(actor, critic, agent_cfg)
 
     def build_spmd(self, mesh) -> "Trainer":
-        """SPMD variant: phases under shard_map on ``mesh`` (dp gradient sync)."""
-        from r2d2dpg_tpu.parallel import DP_AXIS, SPMDTrainer
+        """Multi-chip variant on ``mesh``: pure-JAX envs run whole phases
+        under ``shard_map`` (SPMDTrainer); host-pool envs use the pjit-style
+        HostSPMDTrainer (sharded device compute, pool stepped from host)."""
+        from r2d2dpg_tpu.parallel import DP_AXIS, HostSPMDTrainer, SPMDTrainer
 
         env = self.env_factory()
+        if getattr(env, "batched", False):
+            agent = self.build_agent(env, axis_name=None)
+            return HostSPMDTrainer(env, agent, self.trainer, mesh)
         agent = self.build_agent(env, axis_name=DP_AXIS)
         return SPMDTrainer(env, agent, self.trainer, mesh)
 

@@ -290,3 +290,16 @@ class DMCHostEnv:
         )
         ts = TimeStep(obs=obs, reward=reward, discount=discount, reset=reset)
         return DMCState(token=state.token + 1), ts
+
+    # ------------------------------------------------- host-level API (SPMD)
+    # The hybrid multi-chip trainer steps the pool from Python between jitted
+    # device calls (ordered io_callback cannot run inside shard_map/pjit-
+    # sharded graphs); resets still go through ``reset`` above (eager
+    # io_callback outside jit), so only the step needs a numpy twin.
+    def host_step(self, actions: np.ndarray):
+        """numpy step: canonical [-1,1] actions -> (obs, reward, discount, reset)."""
+        lo, hi = self._act_min, self._act_max
+        scaled = lo + (np.clip(actions, -1.0, 1.0) + 1.0) * 0.5 * (hi - lo)
+        return self._pool.step_all(
+            scaled.astype(np.float32), repeat=self.action_repeat
+        )

@@ -1,11 +1,13 @@
 """SPMD parallelism (SURVEY.md §2.8): dp mesh, sharded replay, ICI psum."""
 
 from r2d2dpg_tpu.parallel import distributed
+from r2d2dpg_tpu.parallel.hybrid import HostSPMDTrainer
 from r2d2dpg_tpu.parallel.mesh import DP_AXIS, make_mesh, replicated, sharded
 from r2d2dpg_tpu.parallel.spmd import SPMDTrainer
 
 __all__ = [
     "DP_AXIS",
+    "HostSPMDTrainer",
     "SPMDTrainer",
     "distributed",
     "make_mesh",

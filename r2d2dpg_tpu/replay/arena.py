@@ -80,12 +80,22 @@ class ReplayArena:
     the ``ArenaState`` pytree threaded through ``add``/``sample``/``update``.
     """
 
-    def __init__(self, capacity: int, *, prioritized: bool = True, alpha: float = 0.6):
+    def __init__(
+        self,
+        capacity: int,
+        *,
+        prioritized: bool = True,
+        alpha: float = 0.6,
+        use_pallas: bool = True,
+    ):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = capacity
         self.prioritized = prioritized
         self.alpha = alpha
+        # Pallas needs single-device refs; trainers whose arena buffers carry
+        # an explicit mesh sharding (parallel.hybrid) use the XLA scatter.
+        self.use_pallas = use_pallas
 
     # ------------------------------------------------------------------ init
     def init_state(self, example: SequenceBatch) -> ArenaState:
@@ -164,9 +174,11 @@ class ReplayArena:
         self, state: ArenaState, indices: jnp.ndarray, priorities: jnp.ndarray
     ) -> ArenaState:
         """Learner write-back of fresh sequence priorities (SURVEY §2.4)."""
-        from r2d2dpg_tpu.ops.pallas import priority_scatter
+        values = jnp.maximum(priorities, PRIORITY_EPS)
+        if self.use_pallas:
+            from r2d2dpg_tpu.ops.pallas import priority_scatter
 
-        new_priority = priority_scatter(
-            state.priority, indices, jnp.maximum(priorities, PRIORITY_EPS)
-        )
+            new_priority = priority_scatter(state.priority, indices, values)
+        else:
+            new_priority = state.priority.at[indices].set(values)
         return dataclasses.replace(state, priority=new_priority)

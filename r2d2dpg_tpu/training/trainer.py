@@ -147,6 +147,18 @@ class Trainer:
             return key
         return jax.random.fold_in(key, lax.axis_index(self.axis))
 
+    def _reshard_add(self, seq, prios):
+        """Hook: relayout emitted sequences + priorities before arena.add.
+
+        Runs AFTER the initial-priority computation so that expensive
+        forward stays in the sequences' collected layout (dp-sharded in the
+        hybrid trainer) rather than being replicated."""
+        return seq, prios
+
+    def _reshard_batch(self, batch):
+        """Hook: relayout a sampled batch before the learner step."""
+        return batch
+
     # ------------------------------------------------------------------ init
     def init(self, key: Optional[jax.Array] = None) -> TrainerState:
         cfg = self.config
@@ -313,6 +325,7 @@ class Trainer:
             )
         else:
             prios = jnp.ones((self.config.num_envs,))
+        seq, prios = self._reshard_add(seq, prios)
         arena = self.arena.add(state.arena, seq, prios)
         return dataclasses.replace(state, arena=arena)
 
@@ -330,7 +343,9 @@ class Trainer:
                 w = importance_weights(res.probs, self.arena.size(arena), beta=beta)
             else:
                 w = jnp.ones((cfg.batch_size,))
-            train, prios, metrics = self.agent.learner_step(train, res.batch, w)
+            train, prios, metrics = self.agent.learner_step(
+                train, self._reshard_batch(res.batch), w
+            )
             if cfg.prioritized:
                 arena = self.arena.update_priorities(arena, res.indices, prios)
             return (train, arena), metrics
