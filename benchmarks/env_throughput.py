@@ -1,0 +1,98 @@
+"""Environment-fleet throughput benchmarks.
+
+Measures the two collection paths (SURVEY.md §3.5: actor-side time goes to
+env stepping + policy forwards):
+
+1. ``pendulum``: the fully on-device path — vmapped pure-JAX Pendulum fleet
+   stepped with the LSTM policy inside one jitted ``lax.scan`` (the Anakin
+   hot loop).  Reports agent steps/sec (num_envs x scan steps / wall).
+2. ``walker``: the native C++ MuJoCo pool stepped host-side (the hybrid /
+   io_callback path's host half), with action repeat 2.
+
+Usage: python benchmarks/env_throughput.py [num_envs] [steps]
+Prints one JSON line per benchmark.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_pendulum(num_envs: int, steps: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from r2d2dpg_tpu.envs import Pendulum
+    from r2d2dpg_tpu.models import ActorNet
+
+    env = Pendulum()
+    actor = ActorNet(action_dim=1, hidden=256, use_lstm=True)
+    key = jax.random.PRNGKey(0)
+    env_keys = jax.random.split(key, num_envs)
+    state, ts = jax.vmap(env.reset)(env_keys)
+    carry = actor.initial_carry(num_envs)
+    params = actor.init(key, ts.obs, carry, ts.reset)
+
+    @jax.jit
+    def rollout(params, state, obs, reset, carry, key):
+        def step(c, k):
+            state, obs, reset, carry = c
+            action, carry = actor.apply(params, obs, carry, reset)
+            ks = jax.random.split(k, num_envs)
+            state, ts = jax.vmap(env.step)(state, action, ks)
+            return (state, ts.obs, ts.reset, carry), ts.reward.mean()
+
+        c, rews = jax.lax.scan(
+            step, (state, obs, reset, carry), jax.random.split(key, steps)
+        )
+        return c, rews.mean()
+
+    c, _ = rollout(params, state, ts.obs, ts.reset, carry, key)  # compile
+    jax.block_until_ready(c[1])
+    t0 = time.perf_counter()
+    c, out = rollout(params, c[0], c[1], c[2], c[3], jax.random.fold_in(key, 1))
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "pendulum_env_steps_per_sec",
+        "value": round(num_envs * steps / dt, 1),
+        "unit": "agent steps/s",
+        "num_envs": num_envs,
+    }
+
+
+def bench_walker(num_envs: int, steps: int) -> dict:
+    import numpy as np
+
+    from r2d2dpg_tpu.envs import native_pool
+
+    pool = native_pool.NativeEnvPool("walker", "walk")
+    pool.reset_all(np.arange(num_envs))
+    a = np.zeros((num_envs, pool.action_dim), np.float32)
+    pool.step_all(a, repeat=2)  # warm
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        pool.step_all(a, repeat=2)
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "walker_native_pool_steps_per_sec",
+        "value": round(num_envs * steps / dt, 1),
+        "unit": "agent steps/s (repeat 2)",
+        "num_envs": num_envs,
+    }
+
+
+def main() -> None:
+    num_envs = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 200
+    print(json.dumps(bench_pendulum(num_envs, steps)))
+    print(json.dumps(bench_walker(num_envs, min(steps, 100))))
+
+
+if __name__ == "__main__":
+    main()

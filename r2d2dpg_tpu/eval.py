@@ -1,0 +1,97 @@
+"""Standalone evaluation entry point.
+
+``python -m r2d2dpg_tpu.eval --config walker_r2d2 --checkpoint-dir runs/x/ckpt``
+
+Restores the latest checkpoint and rolls deterministic (noise-free) episodes
+with the trained policy, printing per-round and aggregate returns.  This is
+the post-training half of the reference's workflow (SURVEY.md §2.7: the
+reference only ever logs noisy actor returns during training; the build
+scores checkpoints on the BASELINE metric — deterministic return).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+from r2d2dpg_tpu.configs import CONFIGS, get_config
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="python -m r2d2dpg_tpu.eval", description=__doc__
+    )
+    p.add_argument("--config", required=True, choices=sorted(CONFIGS))
+    p.add_argument("--checkpoint-dir", required=True)
+    p.add_argument("--episodes", type=int, default=10, help="eval episodes (one env each)")
+    p.add_argument("--rounds", type=int, default=1, help="repeat with fresh seeds")
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def _restore_learner(trainer, checkpoint_dir: str):
+    """Restore ONLY the learner subtree (params/targets/opt/step) of the
+    latest checkpoint.
+
+    The structure template comes from ``jax.eval_shape(trainer.init)`` — no
+    env fleet is constructed and nothing runs — and the restore is orbax
+    ``partial_restore`` of the ``train`` sub-tree only, so the (potentially
+    GBs of) replay arena is never read from disk.  Because env-shaped leaves
+    are skipped entirely, checkpoints written with train-time overrides like
+    ``--num-envs`` restore fine against the stock config.
+    """
+    import jax
+    import orbax.checkpoint as ocp
+
+    template = jax.eval_shape(trainer.init)
+    mgr = ocp.CheckpointManager(checkpoint_dir)
+    try:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {checkpoint_dir}")
+        out = mgr.restore(
+            step,
+            args=ocp.args.PyTreeRestore(
+                {"train": template.train}, partial_restore=True
+            ),
+        )
+        return out["train"]
+    finally:
+        mgr.close()
+
+
+def main(argv=None) -> dict:
+    args = parse_args(argv)
+    import jax
+
+    from r2d2dpg_tpu.training.evaluator import Evaluator
+
+    cfg = get_config(args.config)
+    trainer = cfg.build()
+    train = _restore_learner(trainer, args.checkpoint_dir)
+    step = int(train.step)
+
+    evaluator = Evaluator(
+        cfg.env_factory(), trainer.agent.actor, num_envs=args.episodes
+    )
+    key = jax.random.PRNGKey(args.seed)
+    means = []
+    for r in range(args.rounds):
+        key, k = jax.random.split(key)
+        res = evaluator.run(train.actor_params, k)
+        means.append(res["eval_return_mean"])
+        print(json.dumps({"round": r, "learner_step": step, **res}), flush=True)
+    summary = {
+        "learner_step": step,
+        "rounds": args.rounds,
+        "episodes_per_round": args.episodes,
+        "eval_return_mean": float(np.mean(means)),
+    }
+    print(json.dumps(summary), flush=True)
+    return summary
+
+
+if __name__ == "__main__":
+    main()

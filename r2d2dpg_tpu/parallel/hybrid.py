@@ -36,7 +36,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from r2d2dpg_tpu.agents.ddpg import R2D2DPG
 from r2d2dpg_tpu.envs.dmc_host import DMCHostEnv
-from r2d2dpg_tpu.ops import gaussian_noise, ou_step
 from r2d2dpg_tpu.parallel.mesh import DP_AXIS
 from r2d2dpg_tpu.parallel.spmd import _state_spec
 from r2d2dpg_tpu.training.assembler import StepRecord, shift_in
@@ -71,8 +70,17 @@ class HostSPMDTrainer(Trainer):
                 "agent with axis_name=None (got "
                 f"{agent.config.axis_name!r})"
             )
+        if jax.process_count() > 1:
+            raise ValueError(
+                "HostSPMDTrainer is single-process: a multi-host pod needs "
+                "one env pool per process plus "
+                "jax.make_array_from_process_local_data for the obs batch "
+                "(see parallel.distributed) — not yet wired up"
+            )
         d = mesh.shape[DP_AXIS]
-        for field in ("num_envs", "batch_size", "capacity"):
+        # The arena is replicated (see layout note in _build_phases), so only
+        # the genuinely dp-sharded axes need to divide the mesh.
+        for field in ("num_envs", "batch_size"):
             if getattr(config, field) % d:
                 raise ValueError(
                     f"TrainerConfig.{field}={getattr(config, field)} must "
@@ -127,21 +135,13 @@ class HostSPMDTrainer(Trainer):
     def _act_step_impl(
         self, behavior, critic_params, obs, reset, a_carry, c_carry, noise_st, key
     ):
-        """One policy step for the whole fleet (the device half of hot loop A)."""
-        cfg = self.config
-        sigmas = self._local_sigmas()
-        action, a_carry = self.agent.actor.apply(behavior, obs, a_carry, reset)
-        if cfg.noise == "gaussian":
-            action = action + gaussian_noise(key, action, sigmas)
-        elif cfg.noise == "ou":
-            noise_st = jnp.where(reset[:, None] > 0, 0.0, noise_st)
-            noise_st = ou_step(key, noise_st, sigmas)
-            action = action + noise_st
-        action = jnp.clip(action, -1.0, 1.0)
-        _, c_carry = self.agent.critic.apply(
-            critic_params, obs, action, c_carry, reset
+        """One policy step for the whole fleet (the device half of hot loop A);
+        the semantics live in Trainer._policy_step, shared with the in-graph
+        scan collect."""
+        return self._policy_step(
+            behavior, critic_params, obs, reset, a_carry, c_carry, noise_st,
+            self._local_sigmas(), key,
         )
-        return action, a_carry, c_carry, noise_st
 
     def _absorb_impl(
         self,

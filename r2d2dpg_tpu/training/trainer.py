@@ -223,6 +223,29 @@ class Trainer:
             state.behavior_params,
         )
 
+    def _policy_step(
+        self, behavior, critic_params, obs, reset, a_carry, c_carry, noise_st, sigmas, key
+    ):
+        """One fleet-wide policy step: action + noise + clip + carry advance.
+
+        Shared by the in-graph scan collect (below) and the hybrid trainer's
+        host-driven collect (parallel/hybrid.py) so noise/clip/reset
+        semantics cannot drift between the single- and multi-chip paths.
+        """
+        cfg = self.config
+        action, a_carry = self.agent.actor.apply(behavior, obs, a_carry, reset)
+        if cfg.noise == "gaussian":
+            action = action + gaussian_noise(key, action, sigmas)
+        elif cfg.noise == "ou":
+            noise_st = jnp.where(reset[:, None] > 0, 0.0, noise_st)
+            noise_st = ou_step(key, noise_st, sigmas)
+            action = action + noise_st
+        action = jnp.clip(action, -1.0, 1.0)
+        _, c_carry = self.agent.critic.apply(
+            critic_params, obs, action, c_carry, reset
+        )
+        return action, a_carry, c_carry, noise_st
+
     def _collect(self, state: TrainerState) -> Tuple[TrainerState, StepRecord]:
         """Scan ``stride`` vmapped env steps; returns time-major records.
 
@@ -242,18 +265,10 @@ class Trainer:
             env_state, obs, reset, a_carry, c_carry, noise_st, ep_ret = carry
             pre_carries = {"actor": a_carry, "critic": c_carry}
 
-            action, a_carry = self.agent.actor.apply(behavior, obs, a_carry, reset)
             k_noise, k_env = jax.random.split(key)
-            if cfg.noise == "gaussian":
-                action = action + gaussian_noise(k_noise, action, sigmas)
-            elif cfg.noise == "ou":
-                noise_st = jnp.where(reset[:, None] > 0, 0.0, noise_st)
-                noise_st = ou_step(k_noise, noise_st, sigmas)
-                action = action + noise_st
-            action = jnp.clip(action, -1.0, 1.0)
-
-            _, c_carry = self.agent.critic.apply(
-                critic_params, obs, action, c_carry, reset
+            action, a_carry, c_carry, noise_st = self._policy_step(
+                behavior, critic_params, obs, reset, a_carry, c_carry,
+                noise_st, sigmas, k_noise,
             )
 
             if getattr(self.env, "batched", False):

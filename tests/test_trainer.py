@@ -83,3 +83,20 @@ def test_golden_learning_pendulum_ddpg():
     s, m = t.pop_episode_metrics(s)
     assert m["episodes"] > 0
     assert m["episode_return_mean"] > -800, m
+
+
+def test_phases_compile_once_no_retrace():
+    """SURVEY §4.2: each jitted phase traces exactly once across steps."""
+    from r2d2dpg_tpu.configs import PENDULUM_TINY
+
+    t = PENDULUM_TINY.build()
+    s = t.init()
+    for _ in range(t.window_fill_phases + 1):
+        s = t.collect_phase(s)
+    s = t.fill_phase(s)
+    s = t.fill_phase(s)
+    s, _ = t.train_phase(s)
+    s, _ = t.train_phase(s)
+    assert t.collect_phase._cache_size() == 1
+    assert t.fill_phase._cache_size() == 1
+    assert t.train_phase._cache_size() == 1

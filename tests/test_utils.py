@@ -176,3 +176,31 @@ def test_cli_rejects_unknown_config():
 
     with pytest.raises(SystemExit):
         parse_args(["--config", "nope"])
+
+
+def test_eval_cli_from_checkpoint(tmp_path):
+    """python -m r2d2dpg_tpu.eval: restore a checkpoint, score it."""
+    from r2d2dpg_tpu.eval import main as eval_main
+    from r2d2dpg_tpu.train import main as train_main
+
+    ckdir = str(tmp_path / "ck")
+    train_main(
+        [
+            "--config", "pendulum_tiny",
+            "--phases", "2",
+            "--log-every", "0",
+            "--checkpoint-dir", ckdir,
+            "--checkpoint-every", "1",
+        ]
+    )
+    out = eval_main(
+        [
+            "--config", "pendulum_tiny",
+            "--checkpoint-dir", ckdir,
+            "--episodes", "3",
+            "--rounds", "2",
+        ]
+    )
+    assert out["learner_step"] > 0
+    T = 200  # pendulum episode length
+    assert -17.0 * T <= out["eval_return_mean"] <= 0.0
