@@ -1,0 +1,102 @@
+"""Noise processes and the param-staleness fidelity knob (SURVEY.md §2.3).
+
+The reference's actors act with *stale* params refreshed every K env steps;
+``TrainerConfig.param_sync_every=K`` reproduces that.  These tests pin the
+staleness semantics and the statistical behavior of the noise processes.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2dpg_tpu.configs import PENDULUM_TINY
+from r2d2dpg_tpu.ops import gaussian_noise, ou_step
+
+
+def test_gaussian_noise_scales_per_actor():
+    key = jax.random.PRNGKey(0)
+    sigma = jnp.array([0.1, 1.0, 3.0])
+    samples = jnp.stack(
+        [
+            gaussian_noise(jax.random.fold_in(key, i), jnp.zeros((3, 4)), sigma)
+            for i in range(2000)
+        ]
+    )
+    stds = np.asarray(samples.std(axis=(0, 2)))
+    np.testing.assert_allclose(stds, np.asarray(sigma), rtol=0.1)
+
+
+def test_ou_noise_is_autocorrelated_and_mean_reverting():
+    key = jax.random.PRNGKey(1)
+    sigma = jnp.array([0.3])
+    st = jnp.zeros((1, 1))
+    path = []
+    for i in range(3000):
+        st = ou_step(jax.random.fold_in(key, i), st, sigma)
+        path.append(float(st[0, 0]))
+    path = np.asarray(path)
+    # Mean-reverting around 0; successive steps strongly correlated
+    # (theta*dt = 1.5e-3 per step -> lag-1 autocorr ~ 1 - theta*dt).
+    assert abs(path.mean()) < 0.5
+    lag1 = np.corrcoef(path[:-1], path[1:])[0, 1]
+    assert lag1 > 0.9
+    iid = gaussian_noise(key, jnp.zeros((3000, 1)), jnp.array([0.3]))
+    iid_lag1 = np.corrcoef(
+        np.asarray(iid)[:-1, 0], np.asarray(iid)[1:, 0]
+    )[0, 1]
+    assert abs(iid_lag1) < 0.1  # the OU correlation is real, not an artifact
+
+
+def _stale_trainer(k):
+    cfg = dataclasses.replace(
+        PENDULUM_TINY,
+        trainer=dataclasses.replace(
+            PENDULUM_TINY.trainer, param_sync_every=k, num_envs=2,
+            batch_size=4, min_replay=2, capacity=32
+        ),
+    )
+    return cfg.build()
+
+
+def test_param_staleness_behavior_params_refresh_every_k():
+    t = _stale_trainer(k=3)
+    s = t.init()
+    for _ in range(t.window_fill_phases):
+        s = t.collect_phase(s)
+    s = t.fill_phase(s)
+
+    def flat(p):
+        return np.concatenate(
+            [np.asarray(x).ravel() for x in jax.tree_util.tree_leaves(p)]
+        )
+
+    behaviors, onlines = [], []
+    for _ in range(7):
+        s, _ = t.train_phase(s)
+        behaviors.append(flat(s.behavior_params))
+        onlines.append(flat(s.train.actor_params))
+
+    # Online params move every phase...
+    for a, b in zip(onlines, onlines[1:]):
+        assert not np.array_equal(a, b)
+    # ...behavior snapshots only change on refresh phases (every 3rd).
+    changes = [
+        not np.array_equal(a, b) for a, b in zip(behaviors, behaviors[1:])
+    ]
+    assert sum(changes) < len(changes)  # some phases kept the stale snapshot
+    # And stale phases act with params != current online params.
+    assert not np.array_equal(behaviors[-1], onlines[-1]) or changes[-1]
+
+
+def test_param_fresh_default_tracks_online():
+    t = _stale_trainer(k=0)
+    s = t.init()
+    for _ in range(t.window_fill_phases):
+        s = t.collect_phase(s)
+    s = t.fill_phase(s)
+    s, _ = t.train_phase(s)
+    # With always-fresh params the collect phase reads train.actor_params
+    # directly; the stored behavior snapshot is untouched from init.
+    assert int(s.train.step) == 1
