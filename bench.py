@@ -5,34 +5,185 @@ sample from the HBM arena, LSTM burn-in of all four nets, n-step targets,
 IS-weighted critic + actor updates, Polyak, Pallas priority write-back — at
 config-#3 (walker) shapes: batch 64, seq 20+20+5, obs 24, act 6, hidden 256.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "backend"}.
 ``vs_baseline`` compares against ``BENCH_BASELINE.json`` (this repo's first
 recorded TPU number — the reference repo published no benchmark figures;
 see BASELINE.md provenance) or 1.0 if absent.
+
+Resilience (VERDICT r1 weak-point #2): the TPU tunnel on this box flaps and
+can HANG (not raise) during backend init, so the measurement runs in a child
+process with a hard timeout.  The parent retries the TPU child with backoff,
+falls back to a CPU child (axon plugin never registered: the sitecustomize
+hook is gated on ``PALLAS_AXON_POOL_IPS``), and ALWAYS prints one parseable
+JSON line — including on total failure (value 0.0 + "error").
+
+Usage:
+    python bench.py                # measure (TPU, CPU fallback), fp32
+    python bench.py bfloat16       # activation-dtype override experiment
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
+HERE = os.path.dirname(os.path.abspath(__file__))
+METRIC = "learner_steps_per_sec_per_chip"
+# First TPU compile of the chunked learner scan is slow (~1-2 min on a cold
+# cache); give the child plenty, but keep it finite so a hung tunnel cannot
+# eat the driver's whole budget.
+CHILD_TIMEOUT_S = 420
+TPU_TRIES = 3
+BACKOFF_S = (5, 20)
+
+
+def _emit(value: float, vs: float, backend: str, error: str | None = None) -> None:
+    rec = {
+        "metric": METRIC,
+        "value": round(value, 2),
+        "unit": "steps/s",
+        "vs_baseline": round(vs, 3),
+        "backend": backend,
+    }
+    if error:
+        rec["error"] = error[-400:]
+    print(json.dumps(rec))
+
+
+def _baseline() -> float | None:
+    path = os.path.join(HERE, "BENCH_BASELINE.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f).get("value")
+    return None
+
+
+def _run_bounded(cmd: list, env: dict, timeout_s: int):
+    """Run ``cmd`` with a deadline, SIGTERM first on expiry.
+
+    A SIGKILLed JAX client can leave the axon device grant unreleased and
+    hang subsequent TPU ops for minutes; SIGTERM lets the client tear down
+    cleanly.  Returns (rc, stdout, stderr); rc is None on timeout, with
+    whatever output the child produced before dying (the diagnostics for
+    exactly the hang case this exists to debug).
+    """
+    proc = subprocess.Popen(
+        cmd, env=env, cwd=HERE, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            out, err = proc.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+        return None, out, err
+
+
+def _probe_tpu(timeout_s: int = 120) -> bool:
+    """Cheap child that just initializes the TPU backend; True if it's alive.
+
+    Init on a dead tunnel HANGS rather than raising, so paying the full
+    measurement timeout on every retry would waste ~20 min; this probe
+    bounds a hang at ``timeout_s``.
+    """
+    rc, out, err = _run_bounded(
+        [sys.executable, "-c",
+         "import jax; d = jax.devices(); print(len(d), d[0].platform)"],
+        dict(os.environ),
+        timeout_s,
+    )
+    if rc is None:
+        print(f"bench: TPU probe hung >{timeout_s}s; child stderr tail: "
+              f"{err[-500:]}", file=sys.stderr)
+        return False
+    if rc != 0:
+        print(f"bench: TPU probe rc={rc}; stderr tail: {err[-500:]}",
+              file=sys.stderr)
+        return False
+    # Require an actual TPU device: on a box where JAX_PLATFORMS=cpu (the
+    # documented CPU test mode) the probe initializes fine on CPU, and the
+    # "tpu" attempt would silently measure CPU without the interpret-mode
+    # pins the dedicated CPU fallback sets.
+    platform = out.strip().split()[-1] if out.strip() else ""
+    if platform not in ("tpu", "axon"):
+        print(f"bench: probe found platform {platform!r}, not tpu",
+              file=sys.stderr)
+        return False
+    return True
+
+
+def _run_child(dtype: str, backend: str) -> dict | None:
+    """Run the measurement worker in a child; return its parsed JSON or None."""
+    env = dict(os.environ)
+    env["R2D2DPG_BENCH_WORKER"] = "1"
+    if backend == "cpu":
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # axon never registers
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("R2D2DPG_PALLAS_INTERPRET", "1")
+    rc, out, err = _run_bounded(
+        [sys.executable, os.path.abspath(__file__), dtype], env, CHILD_TIMEOUT_S
+    )
+    if rc is None:
+        print(f"bench: {backend} child timed out after {CHILD_TIMEOUT_S}s; "
+              f"stderr tail: {err[-1500:]}", file=sys.stderr)
+        return None
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("metric") == METRIC:
+            return rec
+    print(f"bench: {backend} child rc={rc}; stderr tail: {err[-1500:]}",
+          file=sys.stderr)
+    return None
+
 
 def main() -> None:
+    dtype = sys.argv[1] if len(sys.argv) > 1 else "float32"
+    last_err = "no attempt ran"
+    for i in range(TPU_TRIES):
+        if i:
+            time.sleep(BACKOFF_S[min(i - 1, len(BACKOFF_S) - 1)])
+        if not _probe_tpu():
+            last_err = f"tpu probe {i + 1}/{TPU_TRIES} failed (tunnel down)"
+            continue
+        rec = _run_child(dtype, backend="tpu")
+        if rec is not None:
+            print(json.dumps(rec))
+            return
+        last_err = f"tpu attempt {i + 1}/{TPU_TRIES} failed (timeout or init error)"
+    rec = _run_child(dtype, backend="cpu")
+    if rec is not None:
+        print(json.dumps(rec))
+        return
+    _emit(0.0, 0.0, "none", error=last_err + "; cpu fallback also failed")
+    sys.exit(0)  # the JSON line IS the contract; don't fail the driver's parse
+
+
+def worker() -> None:
+    """Measurement body — runs in a child with the backend already pinned."""
     import jax
     import jax.numpy as jnp
 
-    # Optional activation-dtype override for experiments:
-    #   python bench.py bfloat16
-    # The recorded metric (driver runs with no args) stays the shipped
-    # default (float32 activations).
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
     dtype = jnp.dtype(sys.argv[1]) if len(sys.argv) > 1 else jnp.float32
 
     from r2d2dpg_tpu.agents import AgentConfig, R2D2DPG
     from r2d2dpg_tpu.models import ActorNet, CriticNet
-    from r2d2dpg_tpu.ops import sequence_priority
     from r2d2dpg_tpu.replay import ReplayArena, SequenceBatch
+
+    backend = jax.default_backend()
 
     # Config-#3 (walker_r2d2) learner shapes.
     batch, obs_dim, act_dim, hidden = 64, 24, 6, 256
@@ -73,6 +224,8 @@ def main() -> None:
         arena_state = arena.update_priorities(arena_state, res.indices, prios)
         return (train, arena_state), prios.mean()
 
+    CHUNK = 50
+
     @jax.jit
     def run_chunk(train, arena_state, key):
         keys = jax.random.split(key, CHUNK)
@@ -81,12 +234,11 @@ def main() -> None:
         )
         return train, arena_state, out.mean()
 
-    CHUNK = 50
     # Warm-up / compile.
     train, arena_state, _ = run_chunk(train, arena_state, ks[5])
     jax.block_until_ready(train.step)
 
-    n_chunks = 6
+    n_chunks = 2 if backend == "cpu" else 6  # CPU fallback: keep it finite
     t0 = time.perf_counter()
     for i in range(n_chunks):
         train, arena_state, out = run_chunk(
@@ -96,23 +248,13 @@ def main() -> None:
     dt = time.perf_counter() - t0
     steps_per_sec = n_chunks * CHUNK / dt
 
-    baseline = None
-    base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
-    if os.path.exists(base_path):
-        with open(base_path) as f:
-            baseline = json.load(f).get("value")
+    baseline = _baseline()
     vs = steps_per_sec / baseline if baseline else 1.0
-    print(
-        json.dumps(
-            {
-                "metric": "learner_steps_per_sec_per_chip",
-                "value": round(steps_per_sec, 2),
-                "unit": "steps/s",
-                "vs_baseline": round(vs, 3),
-            }
-        )
-    )
+    _emit(steps_per_sec, vs, backend)
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("R2D2DPG_BENCH_WORKER"):
+        worker()
+    else:
+        main()

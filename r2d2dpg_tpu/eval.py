@@ -46,6 +46,15 @@ def _restore_learner(trainer, checkpoint_dir: str):
     import orbax.checkpoint as ocp
 
     template = jax.eval_shape(trainer.init)
+    # Attach explicit shardings to the abstract template: orbax warns that a
+    # restore without sharding info is unsafe across topologies, and the
+    # sharding-free path is format-fragile across orbax versions (ADVICE r1).
+    dev = jax.local_devices()[0]
+    sharding = jax.sharding.SingleDeviceSharding(dev)
+    train_template = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sharding),
+        template.train,
+    )
     mgr = ocp.CheckpointManager(checkpoint_dir)
     try:
         step = mgr.latest_step()
@@ -54,7 +63,7 @@ def _restore_learner(trainer, checkpoint_dir: str):
         out = mgr.restore(
             step,
             args=ocp.args.PyTreeRestore(
-                {"train": template.train}, partial_restore=True
+                {"train": train_template}, partial_restore=True
             ),
         )
         return out["train"]

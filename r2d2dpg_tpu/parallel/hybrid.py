@@ -119,6 +119,10 @@ class HostSPMDTrainer(Trainer):
         self._dp1 = NamedSharding(mesh, P(DP_AXIS))  # [E, ...] leading axis
         self._dp2 = NamedSharding(mesh, P(None, DP_AXIS))  # [T, E] stacks
         self._act_step = jax.jit(self._act_step_impl)
+        # One dispatch per phase instead of one jnp.where per param leaf
+        # (ADVICE r1: _behavior_params evaluated eagerly was pure host-loop
+        # overhead on the hot collect path).
+        self._collect_setup = jax.jit(self._collect_setup_impl)
         # No donation: the state's obs/reset/carry buffers are also passed
         # as the t=0 entries of the per-step tuples (f(donate(a), a) is
         # rejected by PJRT on real devices).
@@ -132,15 +136,34 @@ class HostSPMDTrainer(Trainer):
         return jax.device_put(state, self._shardings)
 
     # --------------------------------------------------------- device parts
+    def _collect_setup_impl(self, state: TrainerState):
+        """Per-phase device prep: behavior snapshot + the stride's RNG keys.
+
+        With ``param_sync_every > 0`` the snapshot must also PERSIST (the
+        base trainer stores it before collecting so the params acted with
+        are exactly the ones carried until the next sync phase); returning
+        the updated state from here keeps that store inside this one jitted
+        dispatch instead of an eager per-leaf ``jnp.where`` in train_phase.
+        """
+        rng, sk = jax.random.split(state.rng)
+        keys = jax.random.split(sk, self.config.stride)
+        behavior = self._behavior_params(state)
+        if self.config.param_sync_every > 0:
+            state = dataclasses.replace(state, behavior_params=behavior)
+        return state, behavior, keys, rng
+
     def _act_step_impl(
-        self, behavior, critic_params, obs, reset, a_carry, c_carry, noise_st, key
+        self, behavior, critic_params, obs, reset, a_carry, c_carry, noise_st,
+        keys, t
     ):
         """One policy step for the whole fleet (the device half of hot loop A);
         the semantics live in Trainer._policy_step, shared with the in-graph
-        scan collect."""
+        scan collect.  ``keys`` is the phase's [stride, key] stack and ``t``
+        a traced scalar so the per-step key gather happens in-graph (no eager
+        host indexing per step)."""
         return self._policy_step(
             behavior, critic_params, obs, reset, a_carry, c_carry, noise_st,
-            self._local_sigmas(), key,
+            self._local_sigmas(), keys[t],
         )
 
     def _absorb_impl(
@@ -235,9 +258,7 @@ class HostSPMDTrainer(Trainer):
 
     def _host_collect(self, state: TrainerState) -> TrainerState:
         cfg = self.config
-        rng, sk = jax.random.split(state.rng)
-        keys = jax.random.split(sk, cfg.stride)
-        behavior = self._behavior_params(state)
+        state, behavior, keys, rng = self._collect_setup(state)
         critic_params = state.train.critic_params
 
         obs, reset = state.obs, state.reset
@@ -253,7 +274,7 @@ class HostSPMDTrainer(Trainer):
             c_car_T.append(c_carry)
             action, a_carry, c_carry, noise_st = self._act_step(
                 behavior, critic_params, obs, reset, a_carry, c_carry,
-                noise_st, keys[t],
+                noise_st, keys, np.int32(t),
             )
             act_T.append(action)
             # ═══ the one host<->device boundary per collected step ═══
@@ -292,8 +313,5 @@ class HostSPMDTrainer(Trainer):
     def train_phase(
         self, state: TrainerState
     ) -> Tuple[TrainerState, Dict[str, jnp.ndarray]]:
-        if self.config.param_sync_every > 0:
-            state = dataclasses.replace(
-                state, behavior_params=self._behavior_params(state)
-            )
+        # Behavior-snapshot persistence happens inside _collect_setup (jit).
         return self._emit_learn(self._host_collect(state))

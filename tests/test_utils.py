@@ -104,6 +104,22 @@ def test_checkpoint_roundtrip_and_resume(tmp_path):
     ckpt.close()
 
 
+def test_restore_learner_roundtrip(tmp_path):
+    """_restore_learner's partial restore must return the saved learner
+    subtree bit-for-bit (ADVICE r1: pin the orbax dict/dataclass key
+    matching so an orbax upgrade breaking it is caught here, not in eval)."""
+    from r2d2dpg_tpu.eval import _restore_learner
+
+    trainer = PENDULUM_TINY.build()
+    state = trainer.init()
+    ckpt = CheckpointManager(str(tmp_path / "ck"), save_every=1)
+    ckpt.save(1, state)
+    ckpt.wait()
+    ckpt.close()
+    train = _restore_learner(trainer, str(tmp_path / "ck"))
+    _tree_allclose(train, state.train)
+
+
 def test_checkpoint_restore_missing_raises(tmp_path):
     ckpt = CheckpointManager(str(tmp_path / "empty"))
     with pytest.raises(FileNotFoundError):
