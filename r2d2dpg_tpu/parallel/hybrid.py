@@ -18,10 +18,14 @@ whole phases under ``shard_map``, which cannot contain the ordered
   envs, and the [E, obs] batch crosses back, sharded straight onto the mesh.
 
 On one host this trains the DM-Control configs across all local chips.
-Multi-host needs one pool per process plus
-``jax.make_array_from_process_local_data`` for the obs batch — the
-``parallel.distributed`` initializer is the entry point for that; single
-host is what this box can validate (8-device virtual CPU mesh in tests).
+Multi-host (DCN): each process owns a pool of ``num_envs/process_count``
+envs; actions are read from this process's addressable shards, fresh obs
+re-enter the mesh via ``jax.make_array_from_process_local_data``, and the
+jitted phases run as ordinary multi-process SPMD (every host dispatches the
+same computation; XLA routes the gradient/arena collectives over ICI within
+a host and DCN across).  Bring-up is ``parallel.distributed.initialize()``;
+``tests/test_multihost.py`` validates the full path with two real processes
+on a CPU mesh.
 """
 
 from __future__ import annotations
@@ -70,12 +74,12 @@ class HostSPMDTrainer(Trainer):
                 "agent with axis_name=None (got "
                 f"{agent.config.axis_name!r})"
             )
-        if jax.process_count() > 1:
+        self._nproc = jax.process_count()
+        if config.num_envs % max(self._nproc, 1):
             raise ValueError(
-                "HostSPMDTrainer is single-process: a multi-host pod needs "
-                "one env pool per process plus "
-                "jax.make_array_from_process_local_data for the obs batch "
-                "(see parallel.distributed) — not yet wired up"
+                f"TrainerConfig.num_envs={config.num_envs} must be divisible "
+                f"by the process count {self._nproc} (one env pool per host, "
+                f"each owning num_envs/process_count envs)"
             )
         d = mesh.shape[DP_AXIS]
         # The arena is replicated (see layout note in _build_phases), so only
@@ -129,11 +133,70 @@ class HostSPMDTrainer(Trainer):
         self._absorb = jax.jit(self._absorb_impl)
         self._emit_learn = jax.jit(self._emit_learn_impl, donate_argnums=(0,))
         self._emit_only = jax.jit(self._emit_and_add, donate_argnums=(0,))
+        # Overlapped-learner substep (one prioritized update).  NO donation:
+        # while substeps run, the phase's TrainerState pytree still holds
+        # references to the pre-substep train/arena buffers (they ride
+        # through _absorb), so donating here would invalidate live inputs.
+        # Cost of out-of-place: a fresh [capacity] priority array + param
+        # trees per substep — small next to the arena data, which passes
+        # through update_priorities untouched (and uncopied).
+        self._learn_substep = jax.jit(self._learn_substep_impl)
 
     # ----------------------------------------------------------------- init
+    def _env_reset(self, key: jax.Array):
+        """Each process resets only its LOCAL slice of the fleet (its own
+        pool), with a process-diversified key so seeds differ across hosts."""
+        if self._nproc > 1:
+            key = jax.random.fold_in(key, jax.process_index())
+        return self.env.reset(key, self.config.num_envs)
+
     def init(self, key: Optional[jax.Array] = None) -> TrainerState:
-        state = super().init(key)  # eager io_callback reset fills the pool
-        return jax.device_put(state, self._shardings)
+        if self._nproc == 1:
+            state = super().init(key)  # eager io_callback reset fills the pool
+            return jax.device_put(state, self._shardings)
+        # Multi-host (SURVEY §5.8 / docs/PARITY.md delta #3): build a state
+        # with LOCAL fleet shapes (num_envs/process_count envs in this
+        # process's pool; params/arena/counters are process-identical since
+        # every host runs the same seed), then assemble the global
+        # TrainerState — dp-sharded leaves from each process's local rows,
+        # replicated leaves from the (identical) local values.
+        saved = self.config
+        try:
+            # Temporary local view ONLY for the eager init body; the jitted
+            # phase functions trace later, against the restored global config.
+            self.config = dataclasses.replace(
+                saved, num_envs=saved.num_envs // self._nproc
+            )
+            local = super().init(key)
+        finally:
+            self.config = saved
+
+        def to_global(leaf, sharding):
+            arr = np.asarray(leaf)
+            spec = sharding.spec
+            if any(ax == DP_AXIS for ax in spec):
+                gshape = tuple(
+                    dim * self._nproc
+                    if i < len(spec) and spec[i] == DP_AXIS
+                    else dim
+                    for i, dim in enumerate(arr.shape)
+                )
+                return jax.make_array_from_process_local_data(
+                    sharding, arr, gshape
+                )
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx]
+            )
+
+        # ``self._shardings`` is a PREFIX pytree (one sharding can span a
+        # whole subtree, as device_put accepts); broadcast it to the full
+        # state structure before zipping leaf-wise.
+        full_shardings = jax.tree_util.tree_broadcast(
+            self._shardings,
+            local,
+            is_leaf=lambda x: isinstance(x, NamedSharding),
+        )
+        return jax.tree_util.tree_map(to_global, local, full_shardings)
 
     # --------------------------------------------------------- device parts
     def _collect_setup_impl(self, state: TrainerState):
@@ -145,12 +208,18 @@ class HostSPMDTrainer(Trainer):
         the updated state from here keeps that store inside this one jitted
         dispatch instead of an eager per-leaf ``jnp.where`` in train_phase.
         """
-        rng, sk = jax.random.split(state.rng)
+        rng, sk, sl = jax.random.split(state.rng, 3)
         keys = jax.random.split(sk, self.config.stride)
+        lkeys = jax.random.split(sl, max(self.config.learner_steps, 1))
         behavior = self._behavior_params(state)
         if self.config.param_sync_every > 0:
             state = dataclasses.replace(state, behavior_params=behavior)
-        return state, behavior, keys, rng
+        return state, behavior, keys, lkeys, rng
+
+    def _learn_substep_impl(self, train, arena, key):
+        """One prioritized learner update, dispatchable mid-collect (the
+        shared ``Trainer._learn_step`` body, as a standalone jit)."""
+        return self._learn_step(train, arena, key)
 
     def _act_step_impl(
         self, behavior, critic_params, obs, reset, a_carry, c_carry, noise_st,
@@ -253,13 +322,60 @@ class HostSPMDTrainer(Trainer):
 
     # ------------------------------------------------------------ host loop
     def _put_fleet(self, x: np.ndarray) -> jnp.ndarray:
-        """Lay a host [E, ...] batch out over the dp mesh axis."""
-        return jax.device_put(x, self._dp1)
+        """Lay a host [E_local, ...] batch out over the dp mesh axis (global
+        assembly across processes when multi-host)."""
+        if self._nproc == 1:
+            return jax.device_put(x, self._dp1)
+        return jax.make_array_from_process_local_data(
+            self._dp1, x, (x.shape[0] * self._nproc,) + x.shape[1:]
+        )
 
-    def _host_collect(self, state: TrainerState) -> TrainerState:
+    def _put_stack(self, x: np.ndarray) -> jnp.ndarray:
+        """[T, E_local] time-major host stack onto the dp mesh axis (axis 1)."""
+        if self._nproc == 1:
+            return jax.device_put(x, self._dp2)
+        return jax.make_array_from_process_local_data(
+            self._dp2, x, (x.shape[0], x.shape[1] * self._nproc)
+        )
+
+    def _fetch_fleet(self, arr: jnp.ndarray) -> np.ndarray:
+        """Device [E, ...] fleet array -> THIS process's rows as numpy."""
+        if self._nproc == 1:
+            return np.asarray(arr)
+        shards = sorted(
+            arr.addressable_shards,
+            key=lambda s: s.index[0].start if s.index[0].start else 0,
+        )
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+    def _host_collect(
+        self, state: TrainerState, learn: bool = False
+    ) -> Tuple[TrainerState, Optional[Dict[str, jnp.ndarray]]]:
+        """Step the fleet ``stride`` times from the host.
+
+        With ``learn=True`` (the ``overlap_learner`` train path) the phase's
+        ``learner_steps`` updates are dispatched one at a time BETWEEN env
+        steps, spread evenly over the stride: each update executes on the
+        device during the milliseconds the host spends inside the MuJoCo C
+        step, so on a real TPU the learner costs ~zero wall-clock.  The
+        device queue orders act_step(t+1) after the interleaved update, but
+        by the time the host finishes physics for step t the update has
+        drained — max(host, device) instead of host + device.
+
+        Semantics delta vs the sequential path (intentional, documented):
+        interleaved updates sample the arena as of the PREVIOUS emit — the
+        sequence collected this phase enters replay after the phase's
+        updates.  That one-phase sampling lag is exactly the reference's
+        async actor/learner relationship (its learner never sees in-flight
+        actor data either).
+        """
         cfg = self.config
-        state, behavior, keys, rng = self._collect_setup(state)
+        state, behavior, keys, lkeys, rng = self._collect_setup(state)
         critic_params = state.train.critic_params
+        train, arena = state.train, state.arena
+        n_sub = cfg.learner_steps if learn else 0
+        sub = 0
+        metrics_acc = []
 
         obs, reset = state.obs, state.reset
         a_carry, c_carry = state.actor_carry, state.critic_carry
@@ -277,24 +393,32 @@ class HostSPMDTrainer(Trainer):
                 noise_st, keys, np.int32(t),
             )
             act_T.append(action)
+            action_np = self._fetch_fleet(action)
+            # Dispatch this step's share of learner updates AFTER the action
+            # crossed to host (so act_step never waits behind an update) and
+            # BEFORE the physics step (so the update runs under it).
+            while sub < n_sub and (sub + 1) * cfg.stride <= (t + 1) * n_sub:
+                train, arena, m = self._learn_substep(train, arena, lkeys[sub])
+                metrics_acc.append(m)
+                sub += 1
             # ═══ the one host<->device boundary per collected step ═══
-            o, r, d, res = self.env.host_step(np.asarray(action))
+            o, r, d, res = self.env.host_step(action_np)
             rew_T.append(r)
             disc_T.append(d)
             done_T.append(res)
             obs = self._put_fleet(o)
             reset = self._put_fleet(res)
 
-        return self._absorb(
+        state = self._absorb(
             state,
             tuple(obs_T),
             tuple(reset_T),
             tuple(act_T),
             tuple(a_car_T),
             tuple(c_car_T),
-            jax.device_put(np.stack(rew_T), self._dp2),
-            jax.device_put(np.stack(disc_T), self._dp2),
-            jax.device_put(np.stack(done_T), self._dp2),
+            self._put_stack(np.stack(rew_T)),
+            self._put_stack(np.stack(disc_T)),
+            self._put_stack(np.stack(done_T)),
             obs,
             reset,
             a_carry,
@@ -302,16 +426,31 @@ class HostSPMDTrainer(Trainer):
             noise_st,
             rng,
         )
+        if not learn:
+            return state, None
+        state = dataclasses.replace(state, train=train, arena=arena)
+        if not metrics_acc:  # learner_steps=0: a collect-only train phase
+            return state, {}
+        metrics = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs).mean(), *metrics_acc
+        )
+        return state, metrics
 
     # --------------------------------------------------------------- phases
     def collect_phase(self, state: TrainerState) -> TrainerState:
-        return self._host_collect(state)
+        state, _ = self._host_collect(state)
+        return state
 
     def fill_phase(self, state: TrainerState) -> TrainerState:
-        return self._emit_only(self._host_collect(state))
+        state, _ = self._host_collect(state)
+        return self._emit_only(state)
 
     def train_phase(
         self, state: TrainerState
     ) -> Tuple[TrainerState, Dict[str, jnp.ndarray]]:
         # Behavior-snapshot persistence happens inside _collect_setup (jit).
-        return self._emit_learn(self._host_collect(state))
+        if not self.config.overlap_learner:
+            state, _ = self._host_collect(state)
+            return self._emit_learn(state)
+        state, metrics = self._host_collect(state, learn=True)
+        return self._emit_only(state), metrics

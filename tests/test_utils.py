@@ -120,6 +120,82 @@ def test_restore_learner_roundtrip(tmp_path):
     _tree_allclose(train, state.train)
 
 
+@pytest.mark.slow
+def test_checkpoint_survives_sigkill(tmp_path):
+    """Kill a training run mid-flight; --resume must restore from a
+    FINALIZED checkpoint (VERDICT r1: the round-1 long run left only
+    *.orbax-checkpoint-tmp dirs and nothing restorable)."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    ckdir = str(tmp_path / "ck")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("R2D2DPG_PALLAS_INTERPRET", "1")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "r2d2dpg_tpu.train",
+            "--config", "pendulum_tiny",
+            "--phases", "100000",
+            "--log-every", "0",
+            "--checkpoint-dir", ckdir,
+            "--checkpoint-every", "5",
+        ],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        # Wait for at least one finalized checkpoint to exist, then SIGKILL
+        # (no cleanup handlers run — the crash case).
+        deadline = time.time() + 240
+        seen = None
+        while time.time() < deadline:
+            finalized = [
+                d for d in (os.listdir(ckdir) if os.path.isdir(ckdir) else [])
+                if d.isdigit()
+            ]
+            if finalized:
+                seen = max(int(d) for d in finalized)
+                break
+            if proc.poll() is not None:
+                pytest.fail(f"train died early:\n{proc.stdout.read()[-2000:]}")
+            time.sleep(1.0)
+        assert seen is not None, "no finalized checkpoint within 240s"
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+        proc.wait()
+        proc.stdout.close()
+
+    # The manager must see a finalized step and restore it bit-for-bit.
+    ckpt = CheckpointManager(ckdir)
+    assert ckpt.latest_step is not None and ckpt.latest_step >= seen
+    trainer = PENDULUM_TINY.build()
+    restored = resume_state(trainer, ckpt)
+    assert int(restored.phase_idx) >= seen
+    ckpt.close()
+
+    # And a full --resume run continues from it.
+    from r2d2dpg_tpu.train import main as train_main
+
+    train_main(
+        [
+            "--config", "pendulum_tiny",
+            "--phases", "1",
+            "--log-every", "0",
+            "--checkpoint-dir", ckdir,
+            "--checkpoint-every", "1000",
+            "--resume",
+        ]
+    )
+
+
 def test_checkpoint_restore_missing_raises(tmp_path):
     ckpt = CheckpointManager(str(tmp_path / "empty"))
     with pytest.raises(FileNotFoundError):
