@@ -8,9 +8,12 @@ env stepping + policy forwards):
    hot loop).  Reports agent steps/sec (num_envs x scan steps / wall).
 2. ``walker``: the native C++ MuJoCo pool stepped host-side (the hybrid /
    io_callback path's host half), with action repeat 2.
+3. ``pixels``: config-#5 collection — cheetah-run with 64x64 EGL renders on
+   the pinned render-thread pool, action repeat 4.
 
-Usage: python benchmarks/env_throughput.py [num_envs] [steps]
-Prints one JSON line per benchmark.
+Usage: python benchmarks/env_throughput.py [num_envs] [steps] [modes]
+``modes`` is a comma-separated subset of pendulum,walker,pixels (default:
+all three).  Prints one JSON line per benchmark.
 """
 
 from __future__ import annotations
@@ -87,11 +90,51 @@ def bench_walker(num_envs: int, steps: int) -> dict:
     }
 
 
+def bench_cheetah_pixels(num_envs: int, steps: int) -> dict:
+    """Config-#5 collection path: threaded physics + pinned-thread renders."""
+    import numpy as np
+
+    from r2d2dpg_tpu.envs.dmc_host import DMCHostEnv, _HostPool
+
+    env = DMCHostEnv("cheetah", "run", pixels=True, action_repeat=4)
+    import jax
+
+    _, ts = env.reset(jax.random.PRNGKey(0), num_envs)
+    a = np.zeros((num_envs, env.spec.action_dim), np.float32)
+    env.host_step(a)  # warm (EGL context creation per render thread)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        env.host_step(a)
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "cheetah_pixels_env_steps_per_sec",
+        "value": round(num_envs * steps / dt, 1),
+        "unit": "agent steps/s (repeat 4, 64x64 render)",
+        "num_envs": num_envs,
+        "render_threads": min(_HostPool.RENDER_THREADS, num_envs),
+    }
+
+
 def main() -> None:
     num_envs = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 200
-    print(json.dumps(bench_pendulum(num_envs, steps)))
-    print(json.dumps(bench_walker(num_envs, min(steps, 100))))
+    modes = sys.argv[3].split(",") if len(sys.argv) > 3 else [
+        "pendulum", "walker", "pixels"
+    ]
+    unknown = set(modes) - {"pendulum", "walker", "pixels"}
+    if unknown:
+        raise SystemExit(
+            f"unknown mode(s) {sorted(unknown)}; pick from pendulum,walker,pixels"
+        )
+    if "pendulum" in modes:
+        print(json.dumps(bench_pendulum(num_envs, steps)), flush=True)
+    if "walker" in modes:
+        print(json.dumps(bench_walker(num_envs, min(steps, 100))), flush=True)
+    if "pixels" in modes:
+        print(
+            json.dumps(bench_cheetah_pixels(num_envs, min(steps, 50))),
+            flush=True,
+        )
 
 
 if __name__ == "__main__":

@@ -44,6 +44,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="refresh behavior params every K phases (0 = always fresh)"
     )
     p.add_argument(
+        "--overlap-learner", type=int, default=None, choices=[0, 1],
+        help="host-pool trainers: interleave learner updates between env "
+        "steps so they hide under the MuJoCo step (1 = on)"
+    )
+    p.add_argument(
         "--compute-dtype", default=None, choices=["float32", "bfloat16"],
         help="net activation dtype (params/optimizer stay float32)"
     )
@@ -73,11 +78,12 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         ("learner_steps", "learner_steps"),
         ("min_replay", "min_replay"),
         ("param_sync_every", "param_sync_every"),
+        ("overlap_learner", "overlap_learner"),
         ("seed", "seed"),
     ):
         v = getattr(args, flag)
         if v is not None:
-            t[field] = v
+            t[field] = bool(v) if field == "overlap_learner" else v
     if t:
         cfg = dataclasses.replace(
             cfg, trainer=dataclasses.replace(cfg.trainer, **t)
