@@ -128,7 +128,15 @@ class _HostPool:
                     pass
 
         deadline = _time.monotonic() + total_timeout  # bound across ALL threads
-        futs = [t.submit(_free, k) for k, t in enumerate(self.render_threads)]
+        futs = []
+        for k, t in enumerate(self.render_threads):
+            try:
+                # At atexit time CPython has already joined executor threads;
+                # submit() then raises — swallow it (same as the old code)
+                # rather than aborting the whole cleanup loop.
+                futs.append(t.submit(_free, k))
+            except Exception:
+                pass
         for f in futs:
             try:
                 f.result(timeout=max(0.0, deadline - _time.monotonic()))

@@ -18,6 +18,7 @@ initial warm-up — no corrupt sequences enter replay.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Optional
 
 import jax
@@ -42,7 +43,10 @@ class CheckpointManager:
         max_to_keep: int = 3,
         async_save: bool = False,
     ):
-        self.directory = directory
+        # orbax rejects relative paths at SAVE time (deep inside the first
+        # cadence hit — a run can train for minutes and then die); absolutize
+        # up front so `--checkpoint-dir runs/x/ckpt` just works.
+        self.directory = os.path.abspath(directory)
         self.save_every = save_every
         # Synchronous by default (VERDICT r1 weak #3): orbax's async save
         # finalizes on a background thread, which a busy single-core host
@@ -51,7 +55,7 @@ class CheckpointManager:
         # A blocking save is a few seconds every ``save_every`` phases and
         # is durable the moment it returns.
         self._mgr = ocp.CheckpointManager(
-            directory,
+            self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 create=True,

@@ -1,0 +1,12 @@
+#!/bin/bash
+# CPU evidence run for config #3 at reduced scale (1-core box), high replay
+# ratio (16 envs x 16 updates/phase = 1:20). chain_runs.sh picks up configs
+# #5 and #4 when this finishes.
+cd "$(dirname "$0")/.."
+mkdir -p runs/walker_cpu_r2
+exec nice -n 19 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+python -m r2d2dpg_tpu.train --config walker_r2d2 \
+  --num-envs 16 --learner-steps 16 --batch-size 64 --min-replay 500 \
+  --minutes "${1:-160}" --log-every 20 --eval-every 100 --eval-envs 5 \
+  --logdir runs/walker_cpu_r2 --checkpoint-dir runs/walker_cpu_r2/ckpt \
+  --checkpoint-every 200 > runs/walker_cpu_r2/stdout.log 2>&1

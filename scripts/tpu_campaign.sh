@@ -1,0 +1,42 @@
+#!/bin/bash
+# Everything TPU-gated, in one unattended sequence. Fired by tpu_watcher.sh
+# the moment the axon tunnel answers. Logs under runs/tpu/.
+HERE="$(cd "$(dirname "$0")" && pwd)"
+cd "$HERE/.."
+mkdir -p runs/tpu
+exec > runs/tpu/campaign.log 2>&1
+echo "=== TPU campaign start $(date) ==="
+
+# The host core must be free for the north-star run's env pool: stop the
+# CPU evidence runs (the TPU measurement supersedes them) and the chain.
+pkill -f chain_runs
+pkill -f "r2d2dpg_tpu.train"
+sleep 5
+
+echo "--- bench fp32 ---"
+python bench.py | tee runs/tpu/bench_fp32.json
+echo "--- bench bf16 ---"
+python bench.py bfloat16 | tee runs/tpu/bench_bf16.json
+
+echo "--- phase throughput (TPU) ---"
+python benchmarks/phase_throughput.py 64 20 16 | tee runs/tpu/phase_throughput.json
+
+echo "--- env throughput (pendulum on TPU) ---"
+python benchmarks/env_throughput.py 1024 200 pendulum | tee runs/tpu/env_pendulum.json
+
+echo "--- north star: walker 30 min on TPU ---"
+mkdir -p runs/tpu/walker30
+python -m r2d2dpg_tpu.train --config walker_r2d2 \
+  --overlap-learner 1 --learner-steps 48 --num-envs 64 --batch-size 64 \
+  --minutes 30 --log-every 10 --eval-every 50 --eval-envs 10 \
+  --logdir runs/tpu/walker30 --checkpoint-dir runs/tpu/walker30/ckpt \
+  --checkpoint-every 200 | tail -50
+
+echo "--- final deterministic eval ---"
+python -m r2d2dpg_tpu.eval --config walker_r2d2 \
+  --checkpoint-dir runs/tpu/walker30/ckpt --episodes 10 --rounds 2 \
+  | tee runs/tpu/walker30_eval.json
+
+echo "=== TPU campaign done $(date) ==="
+# Resume the CPU evidence chain for whatever window remains.
+setsid nohup bash "$HERE/chain_runs.sh" > runs/chain.log 2>&1 < /dev/null &

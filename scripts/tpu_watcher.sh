@@ -1,0 +1,15 @@
+#!/bin/bash
+# Probe the axon tunnel every 5 min (bounded, SIGTERM on expiry — never
+# SIGKILL a client holding the TPU grant); fire the campaign when it answers.
+HERE="$(cd "$(dirname "$0")" && pwd)"
+cd "$HERE/.."
+mkdir -p runs
+while true; do
+  if timeout --signal=TERM 110 python -c "import jax; d=jax.devices(); assert d[0].platform in ('tpu','axon')" 2>/dev/null; then
+    echo "tunnel up $(date)" >> runs/tpu_watcher.log
+    bash "$HERE/tpu_campaign.sh"
+    exit 0
+  fi
+  echo "tunnel down $(date)" >> runs/tpu_watcher.log
+  sleep 300
+done

@@ -89,6 +89,30 @@ def test_hybrid_overlap_learner_path():
     assert int(state.train.step) == 6
 
 
+def test_hybrid_per_step_jits_stop_retracing():
+    """The host loop dispatches _act_step per env step and _learn_substep per
+    learner update; a retrace per step or per phase (e.g. a Python int key
+    index) would silently destroy collect throughput.  The first phase may
+    legitimately add a second cache entry (init-produced NamedShardings vs
+    jit-output GSPMDShardings hash differently; the re-trace hits the
+    lowering cache, no second XLA compile) — the guard is that the cache
+    stops growing once steady-state shardings flow."""
+    trainer = make_trainer(overlap_learner=True, learner_steps=2)
+    state = trainer.init()
+    for _ in range(trainer.window_fill_phases):
+        state = trainer.collect_phase(state)
+    state = trainer.fill_phase(state)
+    state, _ = trainer.train_phase(state)
+    sizes = {
+        fn: fn._cache_size()
+        for fn in (trainer._act_step, trainer._learn_substep, trainer._collect_setup)
+    }
+    for _ in range(3):
+        state, _ = trainer.train_phase(state)
+    for fn, before in sizes.items():
+        assert fn._cache_size() == before, (fn, before, fn._cache_size())
+
+
 def test_hybrid_env_steps_and_episode_accounting():
     trainer = make_trainer()
     state = trainer.init()

@@ -86,14 +86,61 @@ print(f"RANK{os.environ['RANK']}_OK", flush=True)
 """
 
 
+_SPMD_WORKER = r"""
+import dataclasses, os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["COORD"],
+    num_processes=2,
+    process_id=int(os.environ["RANK"]),
+)
+assert len(jax.devices()) == 4
+
+import numpy as np
+
+from r2d2dpg_tpu.agents import AgentConfig, R2D2DPG
+from r2d2dpg_tpu.configs import PENDULUM_R2D2
+from r2d2dpg_tpu.models import ActorNet, CriticNet
+from r2d2dpg_tpu.parallel import DP_AXIS, SPMDTrainer, make_mesh
+
+env = PENDULUM_R2D2.env_factory()
+agent_cfg = dataclasses.replace(
+    PENDULUM_R2D2.agent, burnin=2, unroll=4, n_step=2, axis_name=DP_AXIS
+)
+agent = R2D2DPG(
+    ActorNet(action_dim=env.spec.action_dim, hidden=16, use_lstm=True),
+    CriticNet(hidden=16, use_lstm=True),
+    agent_cfg,
+)
+tcfg = dataclasses.replace(
+    PENDULUM_R2D2.trainer,
+    num_envs=4, stride=4, batch_size=8, capacity=32, min_replay=4,
+    learner_steps=1,
+)
+trainer = SPMDTrainer(env, agent, tcfg, make_mesh(4))
+state = trainer.run(
+    trainer.window_fill_phases + trainer.replay_fill_phases + 2, log_every=0
+)
+assert int(state.train.step) == 2
+# Gradient pmean crossed the process boundary: params replicated identical.
+leaf = jax.tree_util.tree_leaves(state.train.critic_params)[0]
+shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+for other in shards[1:]:
+    np.testing.assert_array_equal(shards[0], other)
+print(f"RANK{os.environ['RANK']}_OK", flush=True)
+"""
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("overlap", [0, 1])
-def test_two_process_host_pool_training(tmp_path, overlap):
+def _run_two_process(worker: str, extra_env=None):
     port = _free_port()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     procs = []
@@ -105,10 +152,10 @@ def test_two_process_host_pool_training(tmp_path, overlap):
         env["R2D2DPG_PALLAS_INTERPRET"] = "1"
         env["COORD"] = f"127.0.0.1:{port}"
         env["RANK"] = str(rank)
-        env["OVERLAP"] = str(overlap)
+        env.update(extra_env or {})
         procs.append(
             subprocess.Popen(
-                [sys.executable, "-c", _WORKER],
+                [sys.executable, "-c", worker],
                 env=env,
                 cwd=repo,
                 stdout=subprocess.PIPE,
@@ -128,3 +175,13 @@ def test_two_process_host_pool_training(tmp_path, overlap):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
         assert f"RANK{rank}_OK" in out
+
+
+@pytest.mark.parametrize("overlap", [0, 1])
+def test_two_process_host_pool_training(overlap):
+    _run_two_process(_WORKER, {"OVERLAP": str(overlap)})
+
+
+def test_two_process_spmd_training():
+    """Pure-JAX env path (shard_map) across a real process boundary."""
+    _run_two_process(_SPMD_WORKER)
