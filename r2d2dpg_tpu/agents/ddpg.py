@@ -161,6 +161,26 @@ class R2D2DPG:
             reset_tm,
         )
 
+    def _unroll_pi_q(
+        self, actor_params, critic_params, ca, cc, obs_tm, reset_tm
+    ):
+        """Actor and critic advanced in ONE scan: a_t = mu(o_t), q_t = Q(o_t, a_t).
+
+        Halves the sequential-scan count of the two places that unroll the
+        policy and then re-unroll the critic over its actions (the n-step
+        target pass and the actor loss) — per-step math is identical to the
+        two-scan version, the cells just step together.
+        """
+
+        def step(carry, o, r):
+            ca, cc = carry
+            a, ca = self.actor.apply(actor_params, o, ca, r)
+            q, cc = self.critic.apply(critic_params, o, a, cc, r)
+            return (a, q), (ca, cc)
+
+        (a_tm, q_tm), carry = unroll(step, (ca, cc), obs_tm, reset_tm)
+        return a_tm, q_tm, carry
+
     def _burn_in(
         self, state: TrainState, batch: SequenceBatch
     ) -> Tuple[Carry, Carry, Carry, Carry]:
@@ -255,12 +275,15 @@ class R2D2DPG:
         rew_w = batch.reward[:, w]  # batch-major [B, U+n]
         disc_w = batch.discount[:, w]
 
-        # --- n-step targets through the target nets (no gradient).
-        a_tg_tm, _ = self._unroll_actor(
-            state.target_actor_params, ca_tg, obs_w, reset_w
-        )
-        q_tg_tm, _ = self._unroll_critic(
-            state.target_critic_params, cc_tg, obs_w, a_tg_tm, reset_w
+        # --- n-step targets through the target nets (no gradient); the
+        # policy and Q unrolls fuse into one scan (_unroll_pi_q).
+        _, q_tg_tm, _ = self._unroll_pi_q(
+            state.target_actor_params,
+            state.target_critic_params,
+            ca_tg,
+            cc_tg,
+            obs_w,
+            reset_w,
         )
         y = lax.stop_gradient(
             n_step_targets(
@@ -293,9 +316,8 @@ class R2D2DPG:
 
         # --- actor update: -Q(s, mu(s)) through the frozen online critic.
         def actor_loss_fn(actor_params):
-            a_tm, _ = self._unroll_actor(actor_params, ca_on, obs_u, reset_u)
-            q_pi_tm, _ = self._unroll_critic(
-                state.critic_params, cc_on, obs_u, a_tm, reset_u
+            _, q_pi_tm, _ = self._unroll_pi_q(
+                actor_params, state.critic_params, ca_on, cc_on, obs_u, reset_u
             )
             return -q_pi_tm.mean()
 
@@ -358,11 +380,13 @@ class R2D2DPG:
         act_w = _tm(batch.action[:, w])
         reset_w = _tm(batch.reset[:, w])
 
-        a_tg_tm, _ = self._unroll_actor(
-            state.target_actor_params, ca_tg, obs_w, reset_w
-        )
-        q_tg_tm, _ = self._unroll_critic(
-            state.target_critic_params, cc_tg, obs_w, a_tg_tm, reset_w
+        _, q_tg_tm, _ = self._unroll_pi_q(
+            state.target_actor_params,
+            state.target_critic_params,
+            ca_tg,
+            cc_tg,
+            obs_w,
+            reset_w,
         )
         y = n_step_targets(
             batch.reward[:, w],
