@@ -8,7 +8,7 @@ mkdir -p runs/cheetah_pixels_r2
 nice -n 19 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
 python -m r2d2dpg_tpu.train --config cheetah_pixels \
   --num-envs 8 --learner-steps 8 --batch-size 16 --min-replay 200 \
-  --minutes 150 --log-every 10 --eval-every 50 --eval-envs 3 \
+  --minutes 115 --log-every 10 --eval-every 50 --eval-envs 3 \
   --logdir runs/cheetah_pixels_r2 --checkpoint-dir runs/cheetah_pixels_r2/ckpt \
   --checkpoint-every 100 > runs/cheetah_pixels_r2/stdout.log 2>&1
 
@@ -16,6 +16,6 @@ mkdir -p runs/humanoid_r2
 nice -n 19 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
 python -m r2d2dpg_tpu.train --config humanoid_r2d2 \
   --num-envs 16 --learner-steps 16 --batch-size 32 --min-replay 300 \
-  --minutes 130 --log-every 10 --eval-every 50 --eval-envs 3 \
+  --minutes 100 --log-every 10 --eval-every 50 --eval-envs 3 \
   --logdir runs/humanoid_r2 --checkpoint-dir runs/humanoid_r2/ckpt \
   --checkpoint-every 100 > runs/humanoid_r2/stdout.log 2>&1
