@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-2 evidence, phase 2: cheetah_pixels at CPU-affordable shapes, then
+# humanoid. Lighter learner (4 steps/phase, batch 8) than the chain default:
+# on the 1-core box the conv learner dominates the phase, and halving it
+# doubles the env data collected in the window.
+cd "$(dirname "$0")/.."
+mkdir -p runs/cheetah_pixels_r2
+nice -n 19 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+python -m r2d2dpg_tpu.train --config cheetah_pixels \
+  --num-envs 8 --learner-steps 4 --batch-size 8 --min-replay 200 \
+  --minutes 105 --log-every 10 --eval-every 100 --eval-envs 3 \
+  --logdir runs/cheetah_pixels_r2 --checkpoint-dir runs/cheetah_pixels_r2/ckpt \
+  --checkpoint-every 200 > runs/cheetah_pixels_r2/stdout.log 2>&1
+
+mkdir -p runs/humanoid_r2
+nice -n 19 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+python -m r2d2dpg_tpu.train --config humanoid_r2d2 \
+  --num-envs 16 --learner-steps 16 --batch-size 32 --min-replay 300 \
+  --minutes 95 --log-every 10 --eval-every 50 --eval-envs 3 \
+  --logdir runs/humanoid_r2 --checkpoint-dir runs/humanoid_r2/ckpt \
+  --checkpoint-every 100 > runs/humanoid_r2/stdout.log 2>&1
