@@ -28,6 +28,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--episodes", type=int, default=10, help="eval episodes (one env each)")
     p.add_argument("--rounds", type=int, default=1, help="repeat with fresh seeds")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--compute-dtype", default=None, choices=["float32", "bfloat16"],
+        help="net activation dtype, matching the train-time setting "
+        "(params are float32 either way, so checkpoints restore under both)",
+    )
     return p.parse_args(argv)
 
 
@@ -73,11 +78,15 @@ def _restore_learner(trainer, checkpoint_dir: str):
 
 def main(argv=None) -> dict:
     args = parse_args(argv)
+    import dataclasses
+
     import jax
 
     from r2d2dpg_tpu.training.evaluator import Evaluator
 
     cfg = get_config(args.config)
+    if args.compute_dtype is not None:
+        cfg = dataclasses.replace(cfg, compute_dtype=args.compute_dtype)
     trainer = cfg.build()
     train = _restore_learner(trainer, args.checkpoint_dir)
     step = int(train.step)

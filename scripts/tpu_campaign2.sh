@@ -1,0 +1,94 @@
+#!/bin/bash
+# Remaining TPU work after the round-2 wedge (benches fp32/bf16 already
+# recorded in runs/tpu/).  North star first — it is the round's headline —
+# then bf16 walker, throughput benches, and the #4/#5 learning curves.
+#
+# Lesson from the wedge: the axon server dislikes rapid client turnover
+# (phase_throughput connected 5 s after the bench child exited and hung in
+# its first RPC, taking the tunnel down with it).  Every step below settles
+# 60 s before the next client connects.
+HERE="$(cd "$(dirname "$0")" && pwd)"
+cd "$HERE/.."
+mkdir -p runs/tpu
+exec >> runs/tpu/campaign2.log 2>&1
+echo "=== TPU campaign2 start $(date) ==="
+
+# Preempt every prior driver and JAX client class (the round-2 wedge was a
+# benchmark client, not a trainer).  TERM first; escalate to KILL for
+# anything that ignores it (wedged-in-RPC clients do), then settle 60 s
+# before this campaign's first TPU client connects.
+VICTIMS='chain_runs|cheetah_then_humanoid|tpu_campaign\.sh|tpu_watcher\.sh|r2d2dpg_tpu\.(train|eval)|bench\.py|phase_throughput|env_throughput'
+pkill -f "$VICTIMS"
+for i in $(seq 12); do
+  pgrep -f "$VICTIMS" > /dev/null || break
+  sleep 5
+done
+pgrep -f "$VICTIMS" > /dev/null && pkill -9 -f "$VICTIMS"
+sleep 60
+
+echo "--- north star: walker 30 min on TPU $(date) ---"
+mkdir -p runs/tpu/walker30
+python -m r2d2dpg_tpu.train --config walker_r2d2 \
+  --overlap-learner 1 --learner-steps 48 --num-envs 64 --batch-size 64 \
+  --minutes 30 --log-every 10 --eval-every 50 --eval-envs 10 \
+  --logdir runs/tpu/walker30 --checkpoint-dir runs/tpu/walker30/ckpt \
+  --checkpoint-every 200 | tail -40
+sleep 60
+
+echo "--- final deterministic eval $(date) ---"
+if [ -d runs/tpu/walker30/ckpt ] && [ -n "$(ls runs/tpu/walker30/ckpt 2>/dev/null)" ]; then
+  python -m r2d2dpg_tpu.eval --config walker_r2d2 \
+    --checkpoint-dir runs/tpu/walker30/ckpt --episodes 10 --rounds 2 \
+    | tee runs/tpu/walker30_eval.json
+else
+  echo "WALKER30 FAILED: no checkpoint written — skipping eval"
+fi
+sleep 60
+
+echo "--- bf16 walker 30 min $(date) ---"
+mkdir -p runs/tpu/walker30_bf16
+python -m r2d2dpg_tpu.train --config walker_r2d2 --compute-dtype bfloat16 \
+  --overlap-learner 1 --learner-steps 48 --num-envs 64 --batch-size 64 \
+  --minutes 30 --log-every 10 --eval-every 50 --eval-envs 10 \
+  --logdir runs/tpu/walker30_bf16 --checkpoint-dir runs/tpu/walker30_bf16/ckpt \
+  --checkpoint-every 200 | tail -40
+sleep 60
+if [ -d runs/tpu/walker30_bf16/ckpt ] && [ -n "$(ls runs/tpu/walker30_bf16/ckpt 2>/dev/null)" ]; then
+  python -m r2d2dpg_tpu.eval --config walker_r2d2 --compute-dtype bfloat16 \
+    --checkpoint-dir runs/tpu/walker30_bf16/ckpt --episodes 10 --rounds 2 \
+    | tee runs/tpu/walker30_bf16_eval.json
+else
+  echo "WALKER30_BF16 FAILED: no checkpoint written — skipping eval"
+fi
+sleep 60
+
+echo "--- phase throughput (TPU) $(date) ---"
+timeout --kill-after=30 --signal=TERM 1200 python benchmarks/phase_throughput.py 64 20 48 \
+  | tee runs/tpu/phase_throughput.json
+sleep 60
+
+echo "--- env throughput (pendulum on TPU) $(date) ---"
+timeout --kill-after=30 --signal=TERM 600 python benchmarks/env_throughput.py 1024 200 pendulum \
+  | tee runs/tpu/env_pendulum.json
+sleep 60
+
+echo "--- cheetah_pixels (config #5) $(date) ---"
+mkdir -p runs/tpu/cheetah_pixels
+python -m r2d2dpg_tpu.train --config cheetah_pixels \
+  --num-envs 8 --learner-steps 8 --batch-size 16 --min-replay 200 \
+  --overlap-learner 1 \
+  --minutes 100 --log-every 10 --eval-every 50 --eval-envs 3 \
+  --logdir runs/tpu/cheetah_pixels --checkpoint-dir runs/tpu/cheetah_pixels/ckpt \
+  --checkpoint-every 100 | tail -30
+sleep 60
+
+echo "--- humanoid_r2d2 (config #4) $(date) ---"
+mkdir -p runs/tpu/humanoid
+python -m r2d2dpg_tpu.train --config humanoid_r2d2 \
+  --num-envs 16 --learner-steps 16 --batch-size 32 --min-replay 300 \
+  --overlap-learner 1 \
+  --minutes 100 --log-every 10 --eval-every 50 --eval-envs 3 \
+  --logdir runs/tpu/humanoid --checkpoint-dir runs/tpu/humanoid/ckpt \
+  --checkpoint-every 100 | tail -30
+
+echo "=== TPU campaign2 done $(date) ==="

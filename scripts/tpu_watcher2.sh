@@ -1,0 +1,15 @@
+#!/bin/bash
+# Probe the axon tunnel (bounded, SIGTERM); fire campaign2 when it answers.
+HERE="$(cd "$(dirname "$0")" && pwd)"
+cd "$HERE/.."
+mkdir -p runs
+while true; do
+  if timeout --kill-after=30 --signal=TERM 110 python -c "import jax; d=jax.devices(); assert d[0].platform in ('tpu','axon')" 2>/dev/null; then
+    echo "tunnel up $(date)" >> runs/tpu_watcher.log
+    sleep 60
+    bash "$HERE/tpu_campaign2.sh"
+    exit 0
+  fi
+  echo "tunnel down $(date)" >> runs/tpu_watcher.log
+  sleep 240
+done
