@@ -28,7 +28,7 @@ sleep 60
 
 echo "--- north star: walker 30 min on TPU $(date) ---"
 mkdir -p runs/tpu/walker30
-python -m r2d2dpg_tpu.train --config walker_r2d2 \
+timeout --kill-after=60 --signal=TERM 2700 python -m r2d2dpg_tpu.train --config walker_r2d2 \
   --overlap-learner 1 --learner-steps 48 --num-envs 64 --batch-size 64 \
   --minutes 30 --log-every 10 --eval-every 50 --eval-envs 10 \
   --logdir runs/tpu/walker30 --checkpoint-dir runs/tpu/walker30/ckpt \
@@ -37,7 +37,7 @@ sleep 60
 
 echo "--- final deterministic eval $(date) ---"
 if [ -d runs/tpu/walker30/ckpt ] && [ -n "$(ls runs/tpu/walker30/ckpt 2>/dev/null)" ]; then
-  python -m r2d2dpg_tpu.eval --config walker_r2d2 \
+  timeout --kill-after=30 --signal=TERM 900 python -m r2d2dpg_tpu.eval --config walker_r2d2 \
     --checkpoint-dir runs/tpu/walker30/ckpt --episodes 10 --rounds 2 \
     | tee runs/tpu/walker30_eval.json
 else
@@ -47,14 +47,14 @@ sleep 60
 
 echo "--- bf16 walker 30 min $(date) ---"
 mkdir -p runs/tpu/walker30_bf16
-python -m r2d2dpg_tpu.train --config walker_r2d2 --compute-dtype bfloat16 \
+timeout --kill-after=60 --signal=TERM 2700 python -m r2d2dpg_tpu.train --config walker_r2d2 --compute-dtype bfloat16 \
   --overlap-learner 1 --learner-steps 48 --num-envs 64 --batch-size 64 \
   --minutes 30 --log-every 10 --eval-every 50 --eval-envs 10 \
   --logdir runs/tpu/walker30_bf16 --checkpoint-dir runs/tpu/walker30_bf16/ckpt \
   --checkpoint-every 200 | tail -40
 sleep 60
 if [ -d runs/tpu/walker30_bf16/ckpt ] && [ -n "$(ls runs/tpu/walker30_bf16/ckpt 2>/dev/null)" ]; then
-  python -m r2d2dpg_tpu.eval --config walker_r2d2 --compute-dtype bfloat16 \
+  timeout --kill-after=30 --signal=TERM 900 python -m r2d2dpg_tpu.eval --config walker_r2d2 --compute-dtype bfloat16 \
     --checkpoint-dir runs/tpu/walker30_bf16/ckpt --episodes 10 --rounds 2 \
     | tee runs/tpu/walker30_bf16_eval.json
 else
@@ -74,7 +74,7 @@ sleep 60
 
 echo "--- cheetah_pixels (config #5) $(date) ---"
 mkdir -p runs/tpu/cheetah_pixels
-python -m r2d2dpg_tpu.train --config cheetah_pixels \
+timeout --kill-after=60 --signal=TERM 6900 python -m r2d2dpg_tpu.train --config cheetah_pixels \
   --num-envs 8 --learner-steps 8 --batch-size 16 --min-replay 200 \
   --overlap-learner 1 \
   --minutes 100 --log-every 10 --eval-every 50 --eval-envs 3 \
@@ -84,7 +84,7 @@ sleep 60
 
 echo "--- humanoid_r2d2 (config #4) $(date) ---"
 mkdir -p runs/tpu/humanoid
-python -m r2d2dpg_tpu.train --config humanoid_r2d2 \
+timeout --kill-after=60 --signal=TERM 6900 python -m r2d2dpg_tpu.train --config humanoid_r2d2 \
   --num-envs 16 --learner-steps 16 --batch-size 32 --min-replay 300 \
   --overlap-learner 1 \
   --minutes 100 --log-every 10 --eval-every 50 --eval-envs 3 \

@@ -296,3 +296,17 @@ def test_eval_cli_from_checkpoint(tmp_path):
     assert out["learner_step"] > 0
     T = 200  # pendulum episode length
     assert -17.0 * T <= out["eval_return_mean"] <= 0.0
+    # Same checkpoint scores under bf16 activations (params are fp32 in the
+    # checkpoint regardless of train-time compute dtype, so the restore
+    # template matches under both).
+    out_bf16 = eval_main(
+        [
+            "--config", "pendulum_tiny",
+            "--checkpoint-dir", ckdir,
+            "--episodes", "3",
+            "--rounds", "1",
+            "--compute-dtype", "bfloat16",
+        ]
+    )
+    assert out_bf16["learner_step"] == out["learner_step"]
+    assert -17.0 * T <= out_bf16["eval_return_mean"] <= 0.0
