@@ -11,6 +11,7 @@ HERE="$(cd "$(dirname "$0")" && pwd)"
 cd "$HERE/.."
 mkdir -p runs/tpu
 exec >> runs/tpu/campaign2.log 2>&1
+set -o pipefail  # let a timed-out producer fail the whole `... | tee` step
 echo "=== TPU campaign2 start $(date) ==="
 
 # Preempt every prior driver and JAX client class (the round-2 wedge was a
@@ -30,7 +31,7 @@ echo "--- north star: walker 30 min on TPU $(date) ---"
 mkdir -p runs/tpu/walker30
 timeout --kill-after=60 --signal=TERM 2700 python -m r2d2dpg_tpu.train --config walker_r2d2 \
   --overlap-learner 1 --learner-steps 48 --num-envs 64 --batch-size 64 \
-  --minutes 30 --log-every 10 --eval-every 50 --eval-envs 10 \
+  --minutes 30 --log-every 10 --eval-every 200 --eval-envs 5 \
   --logdir runs/tpu/walker30 --checkpoint-dir runs/tpu/walker30/ckpt \
   --checkpoint-every 200 | tail -40
 sleep 60
@@ -39,7 +40,9 @@ echo "--- final deterministic eval $(date) ---"
 if [ -d runs/tpu/walker30/ckpt ] && [ -n "$(ls runs/tpu/walker30/ckpt 2>/dev/null)" ]; then
   timeout --kill-after=30 --signal=TERM 900 python -m r2d2dpg_tpu.eval --config walker_r2d2 \
     --checkpoint-dir runs/tpu/walker30/ckpt --episodes 10 --rounds 2 \
-    | tee runs/tpu/walker30_eval.json
+    | tee runs/tpu/walker30_eval.json.partial \
+    && mv runs/tpu/walker30_eval.json.partial runs/tpu/walker30_eval.json \
+    || echo "walker30_eval step FAILED (timeout or error); left .partial"
 else
   echo "WALKER30 FAILED: no checkpoint written — skipping eval"
 fi
@@ -49,14 +52,16 @@ echo "--- bf16 walker 30 min $(date) ---"
 mkdir -p runs/tpu/walker30_bf16
 timeout --kill-after=60 --signal=TERM 2700 python -m r2d2dpg_tpu.train --config walker_r2d2 --compute-dtype bfloat16 \
   --overlap-learner 1 --learner-steps 48 --num-envs 64 --batch-size 64 \
-  --minutes 30 --log-every 10 --eval-every 50 --eval-envs 10 \
+  --minutes 30 --log-every 10 --eval-every 200 --eval-envs 5 \
   --logdir runs/tpu/walker30_bf16 --checkpoint-dir runs/tpu/walker30_bf16/ckpt \
   --checkpoint-every 200 | tail -40
 sleep 60
 if [ -d runs/tpu/walker30_bf16/ckpt ] && [ -n "$(ls runs/tpu/walker30_bf16/ckpt 2>/dev/null)" ]; then
   timeout --kill-after=30 --signal=TERM 900 python -m r2d2dpg_tpu.eval --config walker_r2d2 --compute-dtype bfloat16 \
     --checkpoint-dir runs/tpu/walker30_bf16/ckpt --episodes 10 --rounds 2 \
-    | tee runs/tpu/walker30_bf16_eval.json
+    | tee runs/tpu/walker30_bf16_eval.json.partial \
+    && mv runs/tpu/walker30_bf16_eval.json.partial runs/tpu/walker30_bf16_eval.json \
+    || echo "walker30_bf16_eval step FAILED (timeout or error); left .partial"
 else
   echo "WALKER30_BF16 FAILED: no checkpoint written — skipping eval"
 fi
@@ -64,12 +69,16 @@ sleep 60
 
 echo "--- phase throughput (TPU) $(date) ---"
 timeout --kill-after=30 --signal=TERM 1200 python benchmarks/phase_throughput.py 64 20 48 \
-  | tee runs/tpu/phase_throughput.json
+  | tee runs/tpu/phase_throughput.json.partial \
+    && mv runs/tpu/phase_throughput.json.partial runs/tpu/phase_throughput.json \
+    || echo "phase_throughput step FAILED (timeout or error); left .partial"
 sleep 60
 
 echo "--- env throughput (pendulum on TPU) $(date) ---"
 timeout --kill-after=30 --signal=TERM 600 python benchmarks/env_throughput.py 1024 200 pendulum \
-  | tee runs/tpu/env_pendulum.json
+  | tee runs/tpu/env_pendulum.json.partial \
+    && mv runs/tpu/env_pendulum.json.partial runs/tpu/env_pendulum.json \
+    || echo "env_pendulum step FAILED (timeout or error); left .partial"
 sleep 60
 
 echo "--- cheetah_pixels (config #5) $(date) ---"
@@ -77,7 +86,7 @@ mkdir -p runs/tpu/cheetah_pixels
 timeout --kill-after=60 --signal=TERM 6900 python -m r2d2dpg_tpu.train --config cheetah_pixels \
   --num-envs 8 --learner-steps 8 --batch-size 16 --min-replay 200 \
   --overlap-learner 1 \
-  --minutes 100 --log-every 10 --eval-every 50 --eval-envs 3 \
+  --minutes 100 --log-every 10 --eval-every 150 --eval-envs 3 \
   --logdir runs/tpu/cheetah_pixels --checkpoint-dir runs/tpu/cheetah_pixels/ckpt \
   --checkpoint-every 100 | tail -30
 sleep 60
@@ -87,7 +96,7 @@ mkdir -p runs/tpu/humanoid
 timeout --kill-after=60 --signal=TERM 6900 python -m r2d2dpg_tpu.train --config humanoid_r2d2 \
   --num-envs 16 --learner-steps 16 --batch-size 32 --min-replay 300 \
   --overlap-learner 1 \
-  --minutes 100 --log-every 10 --eval-every 50 --eval-envs 3 \
+  --minutes 100 --log-every 10 --eval-every 150 --eval-envs 3 \
   --logdir runs/tpu/humanoid --checkpoint-dir runs/tpu/humanoid/ckpt \
   --checkpoint-every 100 | tail -30
 
