@@ -43,6 +43,16 @@ def main() -> None:
     if not rows:
         sys.exit(f"no rows in {args.logdir}/metrics.csv")
 
+    # Eval rows carry only eval_* scalars; fill env_steps forward from the
+    # most recent training row so the curve table shows real step counts.
+    last_steps = 0.0
+    for r in rows:
+        v = fget(r, "env_steps")
+        if v is not None:
+            last_steps = v
+        else:
+            r["env_steps"] = last_steps
+
     ret_key = "eval_return_mean"
     curve = [r for r in rows if fget(r, ret_key) is not None]
     if not curve:

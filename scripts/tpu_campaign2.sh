@@ -38,6 +38,7 @@ sleep 60
 
 echo "--- final deterministic eval $(date) ---"
 if [ -d runs/tpu/walker30/ckpt ] && [ -n "$(ls runs/tpu/walker30/ckpt 2>/dev/null)" ]; then
+  rm -f runs/tpu/walker30_eval.json runs/tpu/walker30_eval.json.partial
   timeout --kill-after=30 --signal=TERM 900 python -m r2d2dpg_tpu.eval --config walker_r2d2 \
     --checkpoint-dir runs/tpu/walker30/ckpt --episodes 10 --rounds 2 \
     | tee runs/tpu/walker30_eval.json.partial \
@@ -57,6 +58,7 @@ timeout --kill-after=60 --signal=TERM 2700 python -m r2d2dpg_tpu.train --config 
   --checkpoint-every 200 | tail -40
 sleep 60
 if [ -d runs/tpu/walker30_bf16/ckpt ] && [ -n "$(ls runs/tpu/walker30_bf16/ckpt 2>/dev/null)" ]; then
+  rm -f runs/tpu/walker30_bf16_eval.json runs/tpu/walker30_bf16_eval.json.partial
   timeout --kill-after=30 --signal=TERM 900 python -m r2d2dpg_tpu.eval --config walker_r2d2 --compute-dtype bfloat16 \
     --checkpoint-dir runs/tpu/walker30_bf16/ckpt --episodes 10 --rounds 2 \
     | tee runs/tpu/walker30_bf16_eval.json.partial \
@@ -68,6 +70,7 @@ fi
 sleep 60
 
 echo "--- phase throughput (TPU) $(date) ---"
+rm -f runs/tpu/phase_throughput.json runs/tpu/phase_throughput.json.partial
 timeout --kill-after=30 --signal=TERM 1200 python benchmarks/phase_throughput.py 64 20 48 \
   | tee runs/tpu/phase_throughput.json.partial \
     && mv runs/tpu/phase_throughput.json.partial runs/tpu/phase_throughput.json \
@@ -75,6 +78,7 @@ timeout --kill-after=30 --signal=TERM 1200 python benchmarks/phase_throughput.py
 sleep 60
 
 echo "--- env throughput (pendulum on TPU) $(date) ---"
+rm -f runs/tpu/env_pendulum.json runs/tpu/env_pendulum.json.partial
 timeout --kill-after=30 --signal=TERM 600 python benchmarks/env_throughput.py 1024 200 pendulum \
   | tee runs/tpu/env_pendulum.json.partial \
     && mv runs/tpu/env_pendulum.json.partial runs/tpu/env_pendulum.json \
