@@ -211,6 +211,9 @@ def run(args) -> dict:
             ):
                 eval_key, k = jax.random.split(eval_key)
                 ev = evaluator.run(state.train.actor_params, k)
+                # Stamp the monotone env-step counter so eval-vs-steps
+                # curves read directly off the CSV/TB row.
+                ev["env_steps"] = float(state.env_steps)
                 logger.log(phase, ev)
                 final.update(ev)
     finally:
