@@ -18,7 +18,7 @@ echo "=== TPU campaign2 start $(date) ==="
 # benchmark client, not a trainer).  TERM first; escalate to KILL for
 # anything that ignores it (wedged-in-RPC clients do), then settle 60 s
 # before this campaign's first TPU client connects.
-VICTIMS='chain_runs|cheetah_then_humanoid|tpu_campaign\.sh|tpu_watcher\.sh|r2d2dpg_tpu\.(train|eval)|bench\.py|phase_throughput|env_throughput'
+VICTIMS='chain_runs|cheetah_then_humanoid|humanoid_retry|walker_long|tpu_campaign\.sh|tpu_watcher\.sh|r2d2dpg_tpu\.(train|eval)|bench\.py|phase_throughput|env_throughput'
 pkill -f "$VICTIMS"
 for i in $(seq 12); do
   pgrep -f "$VICTIMS" > /dev/null || break

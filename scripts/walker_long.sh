@@ -1,0 +1,35 @@
+#!/bin/bash
+# Long config-#3 CPU evidence run: walker learns strongly at ratio 1:20
+# (187.7 @ 485k steps in runs/walker_cpu_r2); give it ~2.5x the data.
+# Gated on the humanoid retry finishing; skips if campaign2 owns the box.
+HERE="$(cd "$(dirname "$0")" && pwd)"
+cd "$HERE/.."
+mkdir -p runs
+exec >> runs/walker_long.log 2>&1
+
+while pgrep -f "r2d2dpg_tpu.train" > /dev/null; do
+  if pgrep -f tpu_campaign2 > /dev/null; then
+    echo "campaign2 owns the box; walker_long not needed $(date)"
+    exit 0
+  fi
+  sleep 60
+done
+if pgrep -f tpu_campaign2 > /dev/null || [ -f runs/tpu/walker30/metrics.csv ]; then
+  echo "campaign2 owns/owned the box; walker_long not needed $(date)"
+  exit 0
+fi
+
+echo "=== walker_long start $(date) ==="
+mkdir -p runs/walker_cpu_long
+nice -n 19 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+python -m r2d2dpg_tpu.train --config walker_r2d2 \
+  --num-envs 16 --learner-steps 16 --batch-size 64 --min-replay 300 \
+  --seed 2 --minutes 170 --log-every 10 --eval-every 150 --eval-envs 5 \
+  --logdir runs/walker_cpu_long --checkpoint-dir runs/walker_cpu_long/ckpt \
+  --checkpoint-every 150 > runs/walker_cpu_long/stdout.log 2>&1
+echo "=== walker_long train done $(date) ==="
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+python -m r2d2dpg_tpu.eval --config walker_r2d2 \
+  --checkpoint-dir runs/walker_cpu_long/ckpt --episodes 10 --rounds 2 \
+  > runs/walker_cpu_long/final_eval.json 2>&1
+echo "=== walker_long done $(date) ==="
