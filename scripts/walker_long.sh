@@ -7,7 +7,10 @@ cd "$HERE/.."
 mkdir -p runs
 exec >> runs/walker_long.log 2>&1
 
-while pgrep -f "r2d2dpg_tpu.train" > /dev/null; do
+# Wait while the box is busy — either a live train process or the humanoid
+# retry driver still pending (its python may not have spawned yet).
+while pgrep -f "r2d2dpg_tpu.train" > /dev/null \
+   || pgrep -f "humanoid_retry.sh" > /dev/null; do
   if pgrep -f tpu_campaign2 > /dev/null; then
     echo "campaign2 owns the box; walker_long not needed $(date)"
     exit 0
@@ -28,8 +31,15 @@ python -m r2d2dpg_tpu.train --config walker_r2d2 \
   --logdir runs/walker_cpu_long --checkpoint-dir runs/walker_cpu_long/ckpt \
   --checkpoint-every 150 > runs/walker_cpu_long/stdout.log 2>&1
 echo "=== walker_long train done $(date) ==="
-PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
-python -m r2d2dpg_tpu.eval --config walker_r2d2 \
-  --checkpoint-dir runs/walker_cpu_long/ckpt --episodes 10 --rounds 2 \
-  > runs/walker_cpu_long/final_eval.json 2>&1
+if [ -d runs/walker_cpu_long/ckpt ] && [ -n "$(ls runs/walker_cpu_long/ckpt 2>/dev/null)" ]; then
+  timeout --kill-after=30 --signal=TERM 1800 \
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+    python -m r2d2dpg_tpu.eval --config walker_r2d2 \
+      --checkpoint-dir runs/walker_cpu_long/ckpt --episodes 10 --rounds 2 \
+      > runs/walker_cpu_long/final_eval.json \
+      2> runs/walker_cpu_long/final_eval.stderr.log \
+    || echo "walker_long eval FAILED (timeout or error)"
+else
+  echo "walker_long: no checkpoint written — skipping eval"
+fi
 echo "=== walker_long done $(date) ==="
