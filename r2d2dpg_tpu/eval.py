@@ -47,9 +47,13 @@ def _restore_learner(trainer, checkpoint_dir: str):
     are skipped entirely, checkpoints written with train-time overrides like
     ``--num-envs`` restore fine against the stock config.
     """
+    import os
+
     import jax
     import orbax.checkpoint as ocp
 
+    # orbax requires absolute paths (utils/checkpoint.py does the same).
+    checkpoint_dir = os.path.abspath(checkpoint_dir)
     template = jax.eval_shape(trainer.init)
     # Attach explicit shardings to the abstract template: orbax warns that a
     # restore without sharding info is unsafe across topologies, and the

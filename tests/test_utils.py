@@ -310,3 +310,28 @@ def test_eval_cli_from_checkpoint(tmp_path):
     )
     assert out_bf16["learner_step"] == out["learner_step"]
     assert -17.0 * T <= out_bf16["eval_return_mean"] <= 0.0
+
+
+def test_eval_cli_relative_checkpoint_dir(tmp_path, monkeypatch):
+    """orbax requires absolute paths; the eval CLI must absolutize
+
+    (regression: a relative --checkpoint-dir raised ValueError from orbax
+    while training with the same relative path worked)."""
+    from r2d2dpg_tpu.eval import main as eval_main
+    from r2d2dpg_tpu.train import main as train_main
+
+    monkeypatch.chdir(tmp_path)
+    train_main(
+        [
+            "--config", "pendulum_tiny",
+            "--phases", "2",
+            "--log-every", "0",
+            "--checkpoint-dir", "ck",
+            "--checkpoint-every", "1",
+        ]
+    )
+    out = eval_main(
+        ["--config", "pendulum_tiny", "--checkpoint-dir", "ck",
+         "--episodes", "2", "--rounds", "1"]
+    )
+    assert out["learner_step"] > 0
