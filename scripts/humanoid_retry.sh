@@ -8,19 +8,10 @@ cd "$HERE/.."
 mkdir -p runs
 exec >> runs/humanoid_retry.log 2>&1
 
-# Wait for the box; bail the moment campaign2 ever claims it (its TPU
-# config-#4 run supersedes this retry), including after it finishes.
-while pgrep -f "r2d2dpg_tpu.train" > /dev/null; do
-  if pgrep -f tpu_campaign2 > /dev/null; then
-    echo "campaign2 owns the box; retry not needed $(date)"
-    exit 0
-  fi
-  sleep 60
-done
-if pgrep -f tpu_campaign2 > /dev/null || [ -f runs/tpu/humanoid/metrics.csv ]; then
-  echo "campaign2 owns/owned the box; retry not needed $(date)"
-  exit 0
-fi
+# Wait for the box; bail if campaign2 ever claims it (its TPU config-#4
+# run supersedes this retry), including after it finishes.
+source "$HERE/lib_gate.sh" || exit 1
+gate_on_box runs/tpu/humanoid/metrics.csv || exit 0
 
 echo "=== humanoid retry start $(date) ==="
 mkdir -p runs/humanoid_r2_long

@@ -1,0 +1,30 @@
+# Shared gating for one-shot CPU evidence-run drivers (sourced, not run).
+#
+#   source "$HERE/lib_gate.sh"
+#   gate_on_box "<campaign artifact>" ["<extra wait pattern>"] || exit 0
+#
+# Blocks while any training process — or anything matching the optional
+# extra pgrep pattern (e.g. a predecessor driver script that hasn't spawned
+# its python yet) — owns the single-core box; returns 1 (caller should
+# exit) if the TPU campaign ever claims the box or already produced the
+# superseding artifact.  One implementation so wait/bail fixes don't have
+# to be applied per-copy (the round-2 scripts each carried their own).
+# NB: never pass a pattern matching the caller's own command line.
+
+gate_on_box() {
+  local artifact="$1" extra="${2:-}"
+  while pgrep -f "r2d2dpg_tpu.train" > /dev/null \
+     || { [ -n "$extra" ] && pgrep -f "$extra" > /dev/null; }; do
+    if pgrep -f tpu_campaign2 > /dev/null; then
+      echo "campaign2 owns the box; skipping $(date)"
+      return 1
+    fi
+    sleep 60
+  done
+  if pgrep -f tpu_campaign2 > /dev/null \
+     || { [ -n "$artifact" ] && [ -f "$artifact" ]; }; then
+    echo "campaign2 owns/owned the box; skipping $(date)"
+    return 1
+  fi
+  return 0
+}

@@ -7,20 +7,10 @@ cd "$HERE/.."
 mkdir -p runs
 exec >> runs/walker_long.log 2>&1
 
-# Wait while the box is busy — either a live train process or the humanoid
-# retry driver still pending (its python may not have spawned yet).
-while pgrep -f "r2d2dpg_tpu.train" > /dev/null \
-   || pgrep -f "humanoid_retry.sh" > /dev/null; do
-  if pgrep -f tpu_campaign2 > /dev/null; then
-    echo "campaign2 owns the box; walker_long not needed $(date)"
-    exit 0
-  fi
-  sleep 60
-done
-if pgrep -f tpu_campaign2 > /dev/null || [ -f runs/tpu/walker30/metrics.csv ]; then
-  echo "campaign2 owns/owned the box; walker_long not needed $(date)"
-  exit 0
-fi
+# Wait while the box is busy — a live train process or the humanoid retry
+# driver still pending (its python may not have spawned yet).
+source "$HERE/lib_gate.sh" || exit 1
+gate_on_box runs/tpu/walker30/metrics.csv "humanoid_retry.sh" || exit 0
 
 echo "=== walker_long start $(date) ==="
 mkdir -p runs/walker_cpu_long
