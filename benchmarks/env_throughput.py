@@ -66,6 +66,9 @@ def bench_pendulum(num_envs: int, steps: int) -> dict:
         "value": round(num_envs * steps / dt, 1),
         "unit": "agent steps/s",
         "num_envs": num_envs,
+        # Device path — automation gates on this being an on-chip number
+        # (scripts/tpu_campaign3.sh json_backend_ok).
+        "backend": jax.default_backend(),
     }
 
 

@@ -48,6 +48,15 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="host-pool trainers: interleave learner updates between env "
         "steps so they hide under the MuJoCo step (1 = on)"
     )
+    # Agent/exploration hyperparameter overrides (VERDICT r2 weak #3: probe
+    # whether the walker plateau is data-bound or hparam-capped).
+    p.add_argument("--sigma-max", type=float, default=None,
+                   help="exploration noise ladder max sigma")
+    p.add_argument("--ladder-alpha", type=float, default=None,
+                   help="noise ladder spread exponent")
+    p.add_argument("--n-step", type=int, default=None, help="n-step TD horizon")
+    p.add_argument("--actor-lr", type=float, default=None)
+    p.add_argument("--critic-lr", type=float, default=None)
     p.add_argument(
         "--compute-dtype", default=None, choices=["float32", "bfloat16"],
         help="net activation dtype (params/optimizer stay float32)"
@@ -80,6 +89,8 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
         ("param_sync_every", "param_sync_every"),
         ("overlap_learner", "overlap_learner"),
         ("seed", "seed"),
+        ("sigma_max", "sigma_max"),
+        ("ladder_alpha", "ladder_alpha"),
     ):
         v = getattr(args, flag)
         if v is not None:
@@ -87,6 +98,15 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
     if t:
         cfg = dataclasses.replace(
             cfg, trainer=dataclasses.replace(cfg.trainer, **t)
+        )
+    a = {}
+    for flag in ("n_step", "actor_lr", "critic_lr"):
+        v = getattr(args, flag)
+        if v is not None:
+            a[flag] = v
+    if a:
+        cfg = dataclasses.replace(
+            cfg, agent=dataclasses.replace(cfg.agent, **a)
         )
     if args.compute_dtype is not None:
         cfg = dataclasses.replace(cfg, compute_dtype=args.compute_dtype)
@@ -117,6 +137,18 @@ def run(args) -> dict:
         trainer = cfg.build_spmd(make_mesh(args.spmd))
     else:
         trainer = cfg.build()
+
+    # Stamp the resolved backend where automation can gate on it: a TPU
+    # campaign step that silently fell back to CPU must not be mistaken
+    # for an on-chip result (round-3 campaign gates .done markers on this).
+    backend = jax.default_backend()
+    print(f"backend: {backend}", flush=True)
+    if args.logdir:
+        import os
+
+        os.makedirs(args.logdir, exist_ok=True)
+        with open(os.path.join(args.logdir, "backend.txt"), "w") as f:
+            f.write(backend + "\n")
 
     ckpt: Optional[CheckpointManager] = None
     if args.checkpoint_dir:

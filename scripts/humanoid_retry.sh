@@ -8,10 +8,12 @@ cd "$HERE/.."
 mkdir -p runs
 exec >> runs/humanoid_retry.log 2>&1
 
-# Wait for the box; bail if campaign2 ever claims it (its TPU config-#4
-# run supersedes this retry), including after it finishes.
+# Wait for the box; bail if the TPU campaign ever claims it (its on-chip
+# config-#4 run supersedes this retry).  Gate on the campaign's COMPLETION
+# marker, not metrics.csv, which appears seconds into a run and would
+# suppress this fallback forever after a killed campaign (ADVICE r2 #2).
 source "$HERE/lib_gate.sh" || exit 1
-gate_on_box runs/tpu/humanoid/metrics.csv || exit 0
+gate_on_box runs/tpu/humanoid/.done || exit 0
 
 echo "=== humanoid retry start $(date) ==="
 mkdir -p runs/humanoid_r2_long

@@ -15,15 +15,15 @@ gate_on_box() {
   local artifact="$1" extra="${2:-}"
   while pgrep -f "r2d2dpg_tpu.train" > /dev/null \
      || { [ -n "$extra" ] && pgrep -f "$extra" > /dev/null; }; do
-    if pgrep -f tpu_campaign2 > /dev/null; then
-      echo "campaign2 owns the box; skipping $(date)"
+    if pgrep -f "tpu_campaign[0-9]*\.sh" > /dev/null; then
+      echo "TPU campaign owns the box; skipping $(date)"
       return 1
     fi
     sleep 60
   done
-  if pgrep -f tpu_campaign2 > /dev/null \
+  if pgrep -f "tpu_campaign[0-9]*\.sh" > /dev/null \
      || { [ -n "$artifact" ] && [ -f "$artifact" ]; }; then
-    echo "campaign2 owns/owned the box; skipping $(date)"
+    echo "TPU campaign owns/owned the box; skipping $(date)"
     return 1
   fi
   return 0

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Probe the axon tunnel (bounded, SIGTERM-first); fire campaign3 when it
+# answers.  Unlike the round-2 watcher this one does NOT exit after firing:
+# campaign3 bails out the moment a step hits its timeout bound (tunnel
+# wedged mid-campaign), and this loop then resumes probing and re-fires the
+# (idempotent) campaign when the tunnel recovers.  Exits only when the
+# campaign has written its terminal runs/tpu/campaign3.complete marker.
+#
+# Probe stderr goes to the log, not /dev/null, so a persistent non-tunnel
+# failure (import error, bad env) is visible instead of looping silently
+# forever (ADVICE r2 #3).
+HERE="$(cd "$(dirname "$0")" && pwd)"
+cd "$HERE/.."
+mkdir -p runs
+while true; do
+  if [ -f runs/tpu/campaign3.complete ]; then
+    echo "campaign3 complete; watcher exiting $(date)" >> runs/tpu_watcher.log
+    exit 0
+  fi
+  if timeout --kill-after=30 --signal=TERM 110 python -c "import jax; d=jax.devices(); assert d[0].platform in ('tpu','axon')" 2>> runs/tpu_watcher.log; then
+    echo "tunnel up $(date)" >> runs/tpu_watcher.log
+    sleep 60
+    bash "$HERE/tpu_campaign3.sh"
+    echo "campaign3 returned rc=$? $(date)" >> runs/tpu_watcher.log
+  fi
+  echo "probe cycle $(date)" >> runs/tpu_watcher.log
+  sleep 240
+done

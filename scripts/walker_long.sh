@@ -10,7 +10,10 @@ exec >> runs/walker_long.log 2>&1
 # Wait while the box is busy — a live train process or the humanoid retry
 # driver still pending (its python may not have spawned yet).
 source "$HERE/lib_gate.sh" || exit 1
-gate_on_box runs/tpu/walker30/metrics.csv "humanoid_retry.sh" || exit 0
+# Gate on the campaign's COMPLETION marker, not metrics.csv (which appears
+# seconds into a run and would suppress this fallback forever after a
+# killed campaign — ADVICE r2 #2).
+gate_on_box runs/tpu/walker30/.done "humanoid_retry.sh" || exit 0
 
 echo "=== walker_long start $(date) ==="
 mkdir -p runs/walker_cpu_long
