@@ -62,12 +62,23 @@ done
 pgrep -f "$VICTIMS" > /dev/null && pkill -9 -f "$VICTIMS"
 sleep 60
 
-# rc 124 = `timeout` fired TERM; 137 = escalated KILL.  Either means a hung
-# client, i.e. the tunnel is wedged — stop the campaign (watcher re-fires).
+# rc 124 = `timeout` fired TERM; 137 = escalated KILL (or the kernel's OOM
+# killer).  Either way the step died abnormally — stop the campaign (the
+# watcher re-fires when the tunnel answers).  Wedge-aborts are budgeted
+# separately from failed full passes: rc 137 can also be a persistent
+# non-tunnel failure (e.g. OOM at the same step every time), so after
+# MAX_WEDGES aborts the campaign gives up rather than re-firing forever.
+MAX_WEDGES=8
 bail_if_wedged() {
   local rc=$1 step=$2
   if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
-    echo "!!! step '$step' hit its timeout (rc=$rc) — tunnel presumed wedged; aborting campaign $(date)"
+    local w=$(($(cat runs/tpu/campaign3.wedges 2>/dev/null || echo 0) + 1))
+    echo "$w" > runs/tpu/campaign3.wedges
+    echo "!!! step '$step' hit its timeout/kill bound (rc=$rc) — abort #$w/$MAX_WEDGES $(date)"
+    if [ "$w" -ge "$MAX_WEDGES" ]; then
+      touch runs/tpu/campaign3.complete
+      echo "=== TPU campaign3 wedge budget spent; giving up $(date) ==="
+    fi
     echo "=== TPU campaign3 ABORT $(date) ==="
     exit 1
   fi

@@ -1,0 +1,71 @@
+#!/bin/bash
+# Walker plateau probe (VERDICT r2 "Next round" #5): both CPU evidence runs
+# flattened in the 160-250 band after ~300k steps at the 16-env / 1:20
+# regime.  This drives one 85-min run per hypothesis at that same regime so
+# the curves are directly comparable to runs/walker_cpu_r2 (251 @ 84 min,
+# seed 0) and runs/walker_cpu_long (seed 2):
+#
+#   sigma08   — exploration-capped?   --sigma-max 0.8      (config: 0.4)
+#   batch256  — gradient-noise-capped? --batch-size 256 --learner-steps 4
+#               (same sampled frames/s as 64x16, 4x the batch)
+#   nstep3    — bootstrap-horizon?    --n-step 3           (config: 5)
+#   criticlr  — critic-speed-capped?  --critic-lr 2e-3     (config: 1e-3)
+#
+# Each probe is skipped when its final_eval.json exists, so this driver can
+# be re-launched after the TPU campaign (whose VICTIMS list kills it — by
+# design: on-chip evidence outranks CPU probes, and at most one partial
+# probe is lost).  Waits politely while anything else owns the single core.
+HERE="$(cd "$(dirname "$0")" && pwd)"
+cd "$HERE/.."
+mkdir -p runs
+exec >> runs/walker_probe.log 2>&1
+
+wait_for_box() {
+  while pgrep -f "r2d2dpg_tpu\.(train|eval)" > /dev/null \
+     || pgrep -f "tpu_campaign[0-9]*\.sh" > /dev/null; do
+    sleep 60
+  done
+}
+
+run_probe() {
+  local name=$1; shift
+  local dir="runs/walker_probe_$name"
+  if [ -s "$dir/final_eval.json" ]; then
+    echo "probe $name: already done, skipping $(date)"
+    return
+  fi
+  wait_for_box
+  echo "=== probe $name start ($*) $(date) ==="
+  rm -rf "$dir"
+  mkdir -p "$dir"
+  nice -n 19 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+  python -m r2d2dpg_tpu.train --config walker_r2d2 \
+    --num-envs 16 --learner-steps 16 --batch-size 64 --min-replay 300 \
+    "$@" \
+    --seed 3 --minutes 85 --log-every 10 --eval-every 150 --eval-envs 5 \
+    --logdir "$dir" --checkpoint-dir "$dir/ckpt" \
+    --checkpoint-every 150 > "$dir/stdout.log" 2>&1
+  echo "=== probe $name train done rc=$? $(date) ==="
+  if [ -d "$dir/ckpt" ] && [ -n "$(ls "$dir/ckpt" 2>/dev/null)" ]; then
+    wait_for_box
+    timeout --kill-after=30 --signal=TERM 1800 \
+      env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+      python -m r2d2dpg_tpu.eval --config walker_r2d2 \
+        --checkpoint-dir "$dir/ckpt" --episodes 10 --rounds 2 \
+        > "$dir/final_eval.jsonl" 2> "$dir/final_eval.stderr.log" \
+      && tail -1 "$dir/final_eval.jsonl" > "$dir/final_eval.json" \
+      || echo "probe $name eval FAILED"
+  else
+    echo "probe $name: no checkpoint — skipping eval"
+  fi
+  echo "=== probe $name done $(date) ==="
+}
+
+# NB: batch256 keeps sampled frames/s constant (256x4 = 64x16) so the
+# comparison isolates batch size from replay ratio.
+run_probe sigma08   --sigma-max 0.8
+run_probe batch256  --batch-size 256 --learner-steps 4
+run_probe nstep3    --n-step 3
+run_probe criticlr  --critic-lr 2e-3
+
+echo "=== walker_probe all done $(date) ==="
