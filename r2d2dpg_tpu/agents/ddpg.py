@@ -78,6 +78,24 @@ class AgentConfig:
     # two matmuls per step become one batched dot on the MXU).  Numerically
     # identical to the unfused path up to matmul reassociation.
     fused_burnin: bool = True
+    # --- overestimation mitigations (round-3; the config-#5 CPU evidence
+    # run collapsed from textbook DDPG critic overestimation — q_mean rose
+    # 0.15 -> 0.95 while eval return fell; docs/RESULTS.md).  Both default
+    # OFF so the baseline DDPG semantics (SURVEY §2.4) are unchanged.
+    #
+    # twin_critic: clipped double-Q (TD3) — two critics as a vmapped
+    # ensemble (leading [2] axis on every critic leaf; TrainState structure
+    # is unchanged), targets bootstrap from min(Q1', Q2'), the actor
+    # ascends Q1.  The ensemble runs as ONE batched unroll on the MXU, so
+    # the twin costs ~one extra critic-sized matmul batch, not a second
+    # sequential scan.
+    twin_critic: bool = False
+    # target_policy_sigma/clip: TD3 target-policy smoothing — the target
+    # action gets clip(N(0, sigma), +-clip) noise before bootstrapping, so
+    # the critic target is a local average instead of a point the actor can
+    # exploit.  sigma 0 disables (and then no RNG key is required).
+    target_policy_sigma: float = 0.0
+    target_policy_clip: float = 0.5
 
     @property
     def seq_len(self) -> int:
@@ -87,6 +105,16 @@ class AgentConfig:
 
 def _tm(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.swapaxes(x, 0, 1)
+
+
+def _stack_n(tree: Any, n: int) -> Any:
+    """Tile a pytree along a new leading ensemble axis of size ``n``."""
+    return jax.tree_util.tree_map(lambda x: jnp.stack([x] * n), tree)
+
+
+def _member(tree: Any, i: int) -> Any:
+    """Member ``i`` of an ensemble-stacked pytree."""
+    return jax.tree_util.tree_map(lambda x: x[i], tree)
 
 
 def _stack2(a: Any, b: Any) -> Any:
@@ -130,9 +158,18 @@ class R2D2DPG:
         actor_params = self.actor.init(
             ka, example_obs, self.actor.initial_carry(b), reset
         )
-        critic_params = self.critic.init(
-            kc, example_obs, example_action, self.critic.initial_carry(b), reset
+        init_critic = lambda k: self.critic.init(  # noqa: E731
+            k, example_obs, example_action, self.critic.initial_carry(b), reset
         )
+        if self.config.twin_critic:
+            # Independent inits stacked on a leading [2] ensemble axis; every
+            # critic consumer vmaps over it (TrainState structure unchanged).
+            critic_params = jax.tree_util.tree_map(
+                lambda a, b_: jnp.stack([a, b_]),
+                *(init_critic(k) for k in jax.random.split(kc)),
+            )
+        else:
+            critic_params = init_critic(kc)
         # Targets start as *copies* — aliased buffers would break donation
         # of the TrainState pytree in the trainer's jitted phases.
         copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)  # noqa: E731
@@ -181,6 +218,55 @@ class R2D2DPG:
         (a_tm, q_tm), carry = unroll(step, (ca, cc), obs_tm, reset_tm)
         return a_tm, q_tm, carry
 
+    def behavior_critic_params(self, state: TrainState):
+        """Critic params for the collection-time carry advance: member 0 in
+        twin mode (the stored carry seeds both members at burn-in, so one
+        member's carry trace is what gets stored)."""
+        if self.config.twin_critic:
+            return _member(state.critic_params, 0)
+        return state.critic_params
+
+    def _apply_critic_ens(self, params, o, a, carry, r):
+        """One critic forward, min-reduced over the ensemble when twin."""
+        if not self.config.twin_critic:
+            return self.critic.apply(params, o, a, carry, r)
+        q2, carry = jax.vmap(
+            lambda p, c: self.critic.apply(p, o, a, c, r)
+        )(params, carry)
+        return q2.min(axis=0), carry
+
+    def _target_q(self, state, ca_tg, cc_tg, obs_tm, reset_tm, eps_tm):
+        """Bootstrap Q through the target nets, time-major ``[T, B]``.
+
+        Plain DDPG (twin off, sigma 0) takes the fused pi+Q scan unchanged;
+        otherwise the per-step action is smoothed with the pre-drawn clipped
+        noise ``eps_tm`` (TD3 target-policy smoothing) and/or Q is the min
+        over the target-critic ensemble (clipped double-Q).
+        """
+        if not self.config.twin_critic and eps_tm is None:
+            _, q_tm, _ = self._unroll_pi_q(
+                state.target_actor_params,
+                state.target_critic_params,
+                ca_tg,
+                cc_tg,
+                obs_tm,
+                reset_tm,
+            )
+            return q_tm
+        ap, cp = state.target_actor_params, state.target_critic_params
+
+        def step(carry, o, r, *e):
+            ca, cc = carry
+            a, ca = self.actor.apply(ap, o, ca, r)
+            if e:
+                a = jnp.clip(a + e[0], -1.0, 1.0)
+            q, cc = self._apply_critic_ens(cp, o, a, cc, r)
+            return q, (ca, cc)
+
+        xs = (obs_tm, reset_tm) + (() if eps_tm is None else (eps_tm,))
+        q_tm, _ = unroll(step, (ca_tg, cc_tg), *xs)
+        return q_tm
+
     def _burn_in(
         self, state: TrainState, batch: SequenceBatch
     ) -> Tuple[Carry, Carry, Carry, Carry]:
@@ -190,43 +276,62 @@ class R2D2DPG:
         and target nets each burn in from the *stored* initial state.
         """
         cfg = self.config
+        nq = 2 if cfg.twin_critic else 1
         ca0, cc0 = batch.carries["actor"], batch.carries["critic"]
+        # With twin critics the stored carry seeds BOTH members (collection
+        # tracks one critic carry; each member warms its own state from it
+        # during burn-in because its params differ).
+        cc0e = _stack_n(cc0, nq) if cfg.twin_critic else cc0
         if cfg.burnin == 0 or not (self.actor.use_lstm or self.critic.use_lstm):
-            return ca0, ca0, cc0, cc0
+            return ca0, ca0, cc0e, cc0e
         obs_b = _tm(batch.obs[:, : cfg.burnin])
         act_b = _tm(batch.action[:, : cfg.burnin])
         reset_b = _tm(batch.reset[:, : cfg.burnin])
         ca_on = ca_tg = ca0
-        cc_on = cc_tg = cc0
+        cc_on = cc_tg = cc0e
         if cfg.fused_burnin:
-            # One scan per net: params stacked [2, ...] (online, target),
-            # the cell step vmapped over that axis; only the final carry is
-            # kept.  ``carry_step(params, carry, *xs_t) -> carry``.
-            def fused(carry_step, p_on, p_tg, c0, xs):
-                p2 = _stack2(p_on, p_tg)
-                c2 = jax.tree_util.tree_map(lambda c: jnp.stack([c, c]), c0)
+            # One scan per net: online+target param ensembles concatenated
+            # on the leading axis ([2] plain, [4] twin), the cell step
+            # vmapped over that axis; only the final carry is kept.
+            # ``carry_step(params, carry, *xs_t) -> carry``.
+            def fused(carry_step, p_all, c0_single, n_all, xs):
+                cN = _stack_n(c0_single, n_all)
                 v = jax.vmap(
                     carry_step, in_axes=(0, 0) + (None,) * len(xs)
                 )
-                c2, _ = lax.scan(lambda c, inp: (v(p2, c, *inp), ()), c2, xs)
-                return _unstack2(c2)
+                cN, _ = lax.scan(lambda c, inp: (v(p_all, c, *inp), ()), cN, xs)
+                return cN
 
             if self.actor.use_lstm:
-                ca_on, ca_tg = fused(
+                c2 = fused(
                     lambda p, c, o, r: self.actor.apply(p, o, c, r)[1],
-                    state.actor_params,
-                    state.target_actor_params,
+                    _stack2(state.actor_params, state.target_actor_params),
                     ca0,
+                    2,
                     (obs_b, reset_b),
                 )
+                ca_on, ca_tg = _unstack2(c2)
             if self.critic.use_lstm:
-                cc_on, cc_tg = fused(
+                cat = lambda on, tg: jax.tree_util.tree_map(  # noqa: E731
+                    lambda x, y: jnp.concatenate([x, y]), on, tg
+                )
+                p_all = (
+                    cat(state.critic_params, state.target_critic_params)
+                    if cfg.twin_critic
+                    else _stack2(state.critic_params, state.target_critic_params)
+                )
+                cN = fused(
                     lambda p, c, o, a, r: self.critic.apply(p, o, a, c, r)[1],
-                    state.critic_params,
-                    state.target_critic_params,
+                    p_all,
                     cc0,
+                    2 * nq,
                     (obs_b, act_b, reset_b),
                 )
+                if cfg.twin_critic:
+                    cc_on = jax.tree_util.tree_map(lambda x: x[:nq], cN)
+                    cc_tg = jax.tree_util.tree_map(lambda x: x[nq:], cN)
+                else:
+                    cc_on, cc_tg = _unstack2(cN)
         else:
             if self.actor.use_lstm:
                 _, ca_on = self._unroll_actor(
@@ -236,12 +341,21 @@ class R2D2DPG:
                     state.target_actor_params, ca0, obs_b, reset_b
                 )
             if self.critic.use_lstm:
-                _, cc_on = self._unroll_critic(
-                    state.critic_params, cc0, obs_b, act_b, reset_b
-                )
-                _, cc_tg = self._unroll_critic(
-                    state.target_critic_params, cc0, obs_b, act_b, reset_b
-                )
+                if cfg.twin_critic:
+                    vunroll = jax.vmap(
+                        lambda p, c: self._unroll_critic(
+                            p, c, obs_b, act_b, reset_b
+                        )[1]
+                    )
+                    cc_on = vunroll(state.critic_params, cc0e)
+                    cc_tg = vunroll(state.target_critic_params, cc0e)
+                else:
+                    _, cc_on = self._unroll_critic(
+                        state.critic_params, cc0, obs_b, act_b, reset_b
+                    )
+                    _, cc_tg = self._unroll_critic(
+                        state.target_critic_params, cc0, obs_b, act_b, reset_b
+                    )
         sg = lax.stop_gradient
         return sg(ca_on), sg(ca_tg), sg(cc_on), sg(cc_tg)
 
@@ -251,6 +365,7 @@ class R2D2DPG:
         state: TrainState,
         batch: SequenceBatch,
         is_weights: jnp.ndarray,
+        key: Optional[jax.Array] = None,
     ) -> Tuple[TrainState, jnp.ndarray, Dict[str, jnp.ndarray]]:
         """One optimization step on a batch of sequences.
 
@@ -258,6 +373,8 @@ class R2D2DPG:
           state: current TrainState.
           batch: ``[B, L, ...]`` sequences, ``L == config.seq_len``.
           is_weights: ``[B]`` importance-sampling weights (ones when uniform).
+          key: RNG for target-policy smoothing; required iff
+            ``config.target_policy_sigma > 0``.
 
         Returns:
           (new_state, new_priorities ``[B]``, metrics).
@@ -275,16 +392,23 @@ class R2D2DPG:
         rew_w = batch.reward[:, w]  # batch-major [B, U+n]
         disc_w = batch.discount[:, w]
 
-        # --- n-step targets through the target nets (no gradient); the
-        # policy and Q unrolls fuse into one scan (_unroll_pi_q).
-        _, q_tg_tm, _ = self._unroll_pi_q(
-            state.target_actor_params,
-            state.target_critic_params,
-            ca_tg,
-            cc_tg,
-            obs_w,
-            reset_w,
-        )
+        # --- n-step targets through the target nets (no gradient); plain
+        # DDPG fuses the policy and Q unrolls into one scan, the mitigation
+        # knobs (ensemble min / smoothing noise) reshape it in _target_q.
+        eps_w = None
+        if cfg.target_policy_sigma > 0:
+            if key is None:
+                raise ValueError(
+                    "AgentConfig.target_policy_sigma > 0 requires "
+                    "learner_step(..., key=...)"
+                )
+            eps_w = jnp.clip(
+                cfg.target_policy_sigma
+                * jax.random.normal(key, act_w.shape, act_w.dtype),
+                -cfg.target_policy_clip,
+                cfg.target_policy_clip,
+            )
+        q_tg_tm = self._target_q(state, ca_tg, cc_tg, obs_w, reset_w, eps_w)
         y = lax.stop_gradient(
             n_step_targets(
                 rew_w,
@@ -302,22 +426,46 @@ class R2D2DPG:
         obs_u, act_u, reset_u = obs_w[:U], act_w[:U], reset_w[:U]
 
         # --- critic update (IS-weighted; SURVEY §2.4 "weighted by IS weights").
+        # Twin mode trains both members against the same min-bootstrapped y
+        # (TD3); td/q metrics and priorities come from member 0.
         def critic_loss_fn(critic_params):
+            if cfg.twin_critic:
+                q_tm2, _ = jax.vmap(
+                    lambda p, c: self._unroll_critic(
+                        p, c, obs_u, act_u, reset_u
+                    )
+                )(critic_params, cc_on)
+                q2 = jnp.swapaxes(q_tm2, 1, 2)  # [2, B, U]
+                td2 = jax.vmap(td_errors, in_axes=(0, None))(q2, y)
+                per_step = huber(td2) if cfg.use_huber else 0.5 * td2**2
+                # SUM over members (TD3's L = L1 + L2): each member's
+                # gradient matches what it would get as the single critic —
+                # a mean would silently halve the effective critic LR.
+                loss = (is_weights[:, None] * per_step.sum(axis=0)).mean()
+                spread = jnp.abs(q2[0] - q2[1]).mean()
+                return loss, (td2[0], q2[0], spread)
             q_tm, _ = self._unroll_critic(critic_params, cc_on, obs_u, act_u, reset_u)
             q = _tm(q_tm)  # [B, U]
             td = td_errors(q, y)
             per_step = huber(td) if cfg.use_huber else 0.5 * td**2
             loss = (is_weights[:, None] * per_step).mean()
-            return loss, (td, q)
+            return loss, (td, q, None)
 
-        (critic_loss, (td, q_pred)), critic_grads = jax.value_and_grad(
+        (critic_loss, (td, q_pred, q_spread)), critic_grads = jax.value_and_grad(
             critic_loss_fn, has_aux=True
         )(state.critic_params)
 
-        # --- actor update: -Q(s, mu(s)) through the frozen online critic.
+        # --- actor update: -Q(s, mu(s)) through the frozen online critic
+        # (member 0 in twin mode, the TD3 convention).
+        cp_pi = (
+            _member(state.critic_params, 0) if cfg.twin_critic
+            else state.critic_params
+        )
+        cc_on_pi = _member(cc_on, 0) if cfg.twin_critic else cc_on
+
         def actor_loss_fn(actor_params):
             _, q_pi_tm, _ = self._unroll_pi_q(
-                actor_params, state.critic_params, ca_on, cc_on, obs_u, reset_u
+                actor_params, cp_pi, ca_on, cc_on_pi, obs_u, reset_u
             )
             return -q_pi_tm.mean()
 
@@ -360,6 +508,8 @@ class R2D2DPG:
             "td_abs_mean": jnp.abs(td).mean(),
             "target_mean": y.mean(),
         }
+        if cfg.twin_critic:
+            metrics["q_spread"] = q_spread  # |Q1-Q2|: overestimation proxy
         return new_state, priorities, metrics
 
     # ------------------------------------------------------- initial priority
@@ -380,14 +530,10 @@ class R2D2DPG:
         act_w = _tm(batch.action[:, w])
         reset_w = _tm(batch.reset[:, w])
 
-        _, q_tg_tm, _ = self._unroll_pi_q(
-            state.target_actor_params,
-            state.target_critic_params,
-            ca_tg,
-            cc_tg,
-            obs_w,
-            reset_w,
-        )
+        # Same bootstrap as the learner (ensemble min in twin mode) so fresh
+        # sequences are ranked on the distribution they will be trained
+        # under; no smoothing noise here — priorities stay deterministic.
+        q_tg_tm = self._target_q(state, ca_tg, cc_tg, obs_w, reset_w, None)
         y = n_step_targets(
             batch.reward[:, w],
             batch.discount[:, w],
@@ -397,8 +543,9 @@ class R2D2DPG:
             gamma=cfg.gamma,
         )
         q_tm, _ = self._unroll_critic(
-            state.critic_params,
-            cc_on,
+            _member(state.critic_params, 0) if cfg.twin_critic
+            else state.critic_params,
+            _member(cc_on, 0) if cfg.twin_critic else cc_on,
             obs_w[: cfg.unroll],
             act_w[: cfg.unroll],
             reset_w[: cfg.unroll],

@@ -33,6 +33,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="net activation dtype, matching the train-time setting "
         "(params are float32 either way, so checkpoints restore under both)",
     )
+    p.add_argument(
+        "--twin-critic", type=int, default=None, choices=[0, 1],
+        help="set when the checkpoint was trained with --twin-critic 1 "
+        "(the critic param tree gains an ensemble axis)",
+    )
     return p.parse_args(argv)
 
 
@@ -91,6 +96,13 @@ def main(argv=None) -> dict:
     cfg = get_config(args.config)
     if args.compute_dtype is not None:
         cfg = dataclasses.replace(cfg, compute_dtype=args.compute_dtype)
+    if args.twin_critic is not None:
+        cfg = dataclasses.replace(
+            cfg,
+            agent=dataclasses.replace(
+                cfg.agent, twin_critic=bool(args.twin_critic)
+            ),
+        )
     trainer = cfg.build()
     train = _restore_learner(trainer, args.checkpoint_dir)
     step = int(train.step)

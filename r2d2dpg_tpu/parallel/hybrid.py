@@ -371,7 +371,7 @@ class HostSPMDTrainer(Trainer):
         """
         cfg = self.config
         state, behavior, keys, lkeys, rng = self._collect_setup(state)
-        critic_params = state.train.critic_params
+        critic_params = self.agent.behavior_critic_params(state.train)
         train, arena = state.train, state.arena
         n_sub = cfg.learner_steps if learn else 0
         sub = 0

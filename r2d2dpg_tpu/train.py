@@ -57,6 +57,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--n-step", type=int, default=None, help="n-step TD horizon")
     p.add_argument("--actor-lr", type=float, default=None)
     p.add_argument("--critic-lr", type=float, default=None)
+    # Overestimation mitigations (agents/ddpg.py AgentConfig; default off).
+    p.add_argument(
+        "--twin-critic", type=int, default=None, choices=[0, 1],
+        help="TD3 clipped double-Q: train a 2-critic ensemble, bootstrap "
+        "from min(Q1',Q2') (eval needs the same flag to restore)"
+    )
+    p.add_argument(
+        "--target-policy-sigma", type=float, default=None,
+        help="TD3 target-policy smoothing noise scale (0 = off)"
+    )
     p.add_argument(
         "--compute-dtype", default=None, choices=["float32", "bfloat16"],
         help="net activation dtype (params/optimizer stay float32)"
@@ -100,10 +110,12 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
             cfg, trainer=dataclasses.replace(cfg.trainer, **t)
         )
     a = {}
-    for flag in ("n_step", "actor_lr", "critic_lr"):
+    for flag in ("n_step", "actor_lr", "critic_lr", "target_policy_sigma"):
         v = getattr(args, flag)
         if v is not None:
             a[flag] = v
+    if args.twin_critic is not None:
+        a["twin_critic"] = bool(args.twin_critic)
     if a:
         cfg = dataclasses.replace(
             cfg, agent=dataclasses.replace(cfg.agent, **a)

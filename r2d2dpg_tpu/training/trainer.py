@@ -267,7 +267,7 @@ class Trainer:
         """
         cfg = self.config
         behavior = self._behavior_params(state)
-        critic_params = state.train.critic_params
+        critic_params = self.agent.behavior_critic_params(state.train)
         sigmas = self._local_sigmas()
         rng, scan_key = jax.random.split(state.rng)
         scan_key = self._fold_axis(scan_key)
@@ -361,6 +361,11 @@ class Trainer:
         the hybrid trainer's interleaved substep jit, so sampling/anneal/
         write-back semantics cannot drift between the two paths."""
         cfg = self.config
+        # fold_in (not split) for the smoothing key: sampling keeps consuming
+        # the substep key directly, so knobs-off runs draw the exact same
+        # batch sequence as round 2 at a fixed seed (the folded key is DCE'd
+        # from the graph when target_policy_sigma == 0).
+        kl = jax.random.fold_in(key, 1)
         res = self.arena.sample(arena, key, cfg.batch_size)
         if cfg.prioritized:
             beta = anneal_beta(train.step, beta0=cfg.beta0, steps=cfg.beta_steps)
@@ -368,7 +373,7 @@ class Trainer:
         else:
             w = jnp.ones((cfg.batch_size,))
         train, prios, metrics = self.agent.learner_step(
-            train, self._reshard_batch(res.batch), w
+            train, self._reshard_batch(res.batch), w, key=kl
         )
         if cfg.prioritized:
             arena = self.arena.update_priorities(arena, res.indices, prios)

@@ -2,6 +2,7 @@
 #
 #   source "$HERE/lib_gate.sh"
 #   gate_on_box "<campaign artifact>" ["<extra wait pattern>"] || exit 0
+#   wait_on_box ["<extra wait pattern>"]   # wait (never bail) for the core
 #
 # Blocks while any training process — or anything matching the optional
 # extra pgrep pattern (e.g. a predecessor driver script that hasn't spawned
@@ -10,6 +11,21 @@
 # superseding artifact.  One implementation so wait/bail fixes don't have
 # to be applied per-copy (the round-2 scripts each carried their own).
 # NB: never pass a pattern matching the caller's own command line.
+
+# Wait (without ever bailing) while anything that owns the single core is
+# live: training/eval pythons, a TPU campaign, or the optional extra
+# pattern.  For preemptible drivers that should RESUME after a campaign
+# rather than skip (walker_probe/cheetah_mitigation carry private copies
+# only because they were live processes when this helper landed — migrate
+# them here on their next at-rest edit).
+wait_on_box() {
+  local extra="${1:-}"
+  while pgrep -f "r2d2dpg_tpu\.(train|eval)" > /dev/null \
+     || pgrep -f "tpu_campaign[0-9]*\.sh" > /dev/null \
+     || { [ -n "$extra" ] && pgrep -f "$extra" > /dev/null; }; do
+    sleep 60
+  done
+}
 
 gate_on_box() {
   local artifact="$1" extra="${2:-}"

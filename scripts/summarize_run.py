@@ -43,15 +43,19 @@ def main() -> None:
     if not rows:
         sys.exit(f"no rows in {args.logdir}/metrics.csv")
 
-    # Eval rows carry only eval_* scalars; fill env_steps forward from the
-    # most recent training row so the curve table shows real step counts.
+    # Eval rows from runs predating train.py's env_steps stamp carry only
+    # eval_* scalars; fill env_steps forward from the most recent training
+    # row, marking filled values "~N" so approximations are visible in the
+    # table (ADVICE r2 #4).
     last_steps = 0.0
-    for r in rows:
+    filled = set()
+    for i, r in enumerate(rows):
         v = fget(r, "env_steps")
         if v is not None:
             last_steps = v
         else:
             r["env_steps"] = last_steps
+            filled.add(i)
 
     ret_key = "eval_return_mean"
     curve = [r for r in rows if fget(r, ret_key) is not None]
@@ -72,13 +76,20 @@ def main() -> None:
     if curve and curve[-1] is not kept[-1]:
         kept.append(curve[-1])
 
+    idx = {id(r): i for i, r in enumerate(rows)}
     print(f"### {args.logdir} — {len(rows)} log rows\n")
     print(f"| wall min | env steps | {label} |")
     print("|---|---|---|")
     for r in kept:
         mins = (fget(r, "wall_seconds") or 0) / 60
         steps = fget(r, "env_steps") or 0
-        print(f"| {mins:.0f} | {steps:,.0f} | {fget(r, ret_key):.1f} |")
+        approx = "~" if idx[id(r)] in filled else ""
+        print(f"| {mins:.0f} | {approx}{steps:,.0f} | {fget(r, ret_key):.1f} |")
+    if any(idx[id(r)] in filled for r in kept):
+        print(
+            "\n(~N = env steps forward-filled from the last training row — "
+            "pre-stamp run)"
+        )
 
     last = rows[-1]
     bits = []
