@@ -119,9 +119,11 @@ def _run_child(dtype: str, backend: str) -> tuple:
         env["R2D2DPG_BENCH_HEARTBEAT"] = hb
     out_f = tempfile.TemporaryFile(mode="w+")
     err_f = tempfile.TemporaryFile(mode="w+")
+    cmd = [sys.executable, os.path.abspath(__file__)]
+    if dtype is not None:
+        cmd.append(dtype)
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), dtype],
-        env=env, cwd=HERE, text=True, stdout=out_f, stderr=err_f,
+        cmd, env=env, cwd=HERE, text=True, stdout=out_f, stderr=err_f,
     )
     start = time.monotonic()
     reason = None
@@ -206,7 +208,8 @@ def _preempt_automation() -> None:
 
 
 def main() -> None:
-    dtype = sys.argv[1] if len(sys.argv) > 1 else "float32"
+    # None = let the worker follow the flagship config's compute dtype.
+    dtype = sys.argv[1] if len(sys.argv) > 1 else None
     _preempt_automation()
     last_err = "no attempt ran"
     for i in range(TPU_TRIES):
@@ -244,11 +247,17 @@ def worker() -> None:
         with open(hb, "w") as f:
             f.write(backend + "\n")
 
-    dtype = jnp.dtype(sys.argv[1]) if len(sys.argv) > 1 else jnp.float32
-
     from r2d2dpg_tpu.agents import AgentConfig, R2D2DPG
+    from r2d2dpg_tpu.configs import WALKER_R2D2
     from r2d2dpg_tpu.models import ActorNet, CriticNet
     from r2d2dpg_tpu.replay import ReplayArena, SequenceBatch
+
+    # No explicit dtype argument -> measure at the flagship config's
+    # compute dtype, so flipping WALKER_R2D2's default (pending the bf16
+    # learning-parity evidence) flips the headline number with it.
+    dtype = jnp.dtype(
+        sys.argv[1] if len(sys.argv) > 1 else WALKER_R2D2.compute_dtype
+    )
 
     # Config-#3 (walker_r2d2) learner shapes.
     batch, obs_dim, act_dim, hidden = 64, 24, 6, 256

@@ -72,12 +72,15 @@ def bench_pendulum(num_envs: int, steps: int) -> dict:
     }
 
 
-def bench_walker(num_envs: int, steps: int) -> dict:
+def bench_native_pool(domain: str, task: str, num_envs: int, steps: int) -> dict:
+    """Per-core physics ceiling for a native-pool task (the number the
+    humanoid scaling arithmetic in docs/RESULTS.md multiplies by host
+    cores — walker and humanoid both supported)."""
     import numpy as np
 
     from r2d2dpg_tpu.envs import native_pool
 
-    pool = native_pool.NativeEnvPool("walker", "walk")
+    pool = native_pool.NativeEnvPool(domain, task)
     pool.reset_all(np.arange(num_envs))
     a = np.zeros((num_envs, pool.action_dim), np.float32)
     pool.step_all(a, repeat=2)  # warm
@@ -86,7 +89,7 @@ def bench_walker(num_envs: int, steps: int) -> dict:
         pool.step_all(a, repeat=2)
     dt = time.perf_counter() - t0
     return {
-        "metric": "walker_native_pool_steps_per_sec",
+        "metric": f"{domain}_native_pool_steps_per_sec",
         "value": round(num_envs * steps / dt, 1),
         "unit": "agent steps/s (repeat 2)",
         "num_envs": num_envs,
@@ -124,15 +127,28 @@ def main() -> None:
     modes = sys.argv[3].split(",") if len(sys.argv) > 3 else [
         "pendulum", "walker", "pixels"
     ]
-    unknown = set(modes) - {"pendulum", "walker", "pixels"}
+    unknown = set(modes) - {"pendulum", "walker", "humanoid", "pixels"}
     if unknown:
         raise SystemExit(
-            f"unknown mode(s) {sorted(unknown)}; pick from pendulum,walker,pixels"
+            f"unknown mode(s) {sorted(unknown)}; pick from "
+            "pendulum,walker,humanoid,pixels"
         )
     if "pendulum" in modes:
         print(json.dumps(bench_pendulum(num_envs, steps)), flush=True)
     if "walker" in modes:
-        print(json.dumps(bench_walker(num_envs, min(steps, 100))), flush=True)
+        print(
+            json.dumps(
+                bench_native_pool("walker", "walk", num_envs, min(steps, 100))
+            ),
+            flush=True,
+        )
+    if "humanoid" in modes:
+        print(
+            json.dumps(
+                bench_native_pool("humanoid", "run", num_envs, min(steps, 100))
+            ),
+            flush=True,
+        )
     if "pixels" in modes:
         print(
             json.dumps(bench_cheetah_pixels(num_envs, min(steps, 50))),
