@@ -26,8 +26,10 @@ never registered: the sitecustomize hook is gated on
 printed — including on total failure (value 0.0 + "error").
 
 Usage:
-    python bench.py                # measure (TPU, CPU fallback), fp32
-    python bench.py bfloat16       # activation-dtype override experiment
+    python bench.py                # measure at the flagship config's dtype
+                                   # (WALKER_R2D2.compute_dtype)
+    python bench.py bfloat16       # explicit activation-dtype override
+    python bench.py float32
 """
 
 from __future__ import annotations
@@ -88,7 +90,7 @@ def _drain(proc) -> None:
         proc.wait()
 
 
-def _run_child(dtype: str, backend: str) -> tuple:
+def _run_child(dtype: str | None, backend: str) -> tuple:
     """Run the measurement worker in ONE child; return (record|None, reason).
 
     For the TPU backend the child must write the heartbeat file (touched by

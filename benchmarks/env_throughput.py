@@ -6,14 +6,16 @@ env stepping + policy forwards):
 1. ``pendulum``: the fully on-device path — vmapped pure-JAX Pendulum fleet
    stepped with the LSTM policy inside one jitted ``lax.scan`` (the Anakin
    hot loop).  Reports agent steps/sec (num_envs x scan steps / wall).
-2. ``walker``: the native C++ MuJoCo pool stepped host-side (the hybrid /
-   io_callback path's host half), with action repeat 2.
+2. ``walker`` / ``humanoid``: the native C++ MuJoCo pool stepped host-side
+   (the hybrid / io_callback path's host half), with action repeat 2 —
+   whole-pool throughput; see ``bench_native_pool`` for the per-core
+   reading.
 3. ``pixels``: config-#5 collection — cheetah-run with 64x64 EGL renders on
    the pinned render-thread pool, action repeat 4.
 
 Usage: python benchmarks/env_throughput.py [num_envs] [steps] [modes]
-``modes`` is a comma-separated subset of pendulum,walker,pixels (default:
-all three).  Prints one JSON line per benchmark.
+``modes`` is a comma-separated subset of pendulum,walker,humanoid,pixels
+(default: pendulum,walker,pixels).  Prints one JSON line per benchmark.
 """
 
 from __future__ import annotations
@@ -73,9 +75,12 @@ def bench_pendulum(num_envs: int, steps: int) -> dict:
 
 
 def bench_native_pool(domain: str, task: str, num_envs: int, steps: int) -> dict:
-    """Per-core physics ceiling for a native-pool task (the number the
+    """Whole-POOL physics throughput for a native-pool task (walker and
+    humanoid supported).  The pool threads over min(cores, num_envs)
+    workers, so this equals the per-core ceiling only on a 1-core host;
+    divide by the reported ``threads`` for per-core (the number the
     humanoid scaling arithmetic in docs/RESULTS.md multiplies by host
-    cores — walker and humanoid both supported)."""
+    cores)."""
     import numpy as np
 
     from r2d2dpg_tpu.envs import native_pool
@@ -93,6 +98,7 @@ def bench_native_pool(domain: str, task: str, num_envs: int, steps: int) -> dict
         "value": round(num_envs * steps / dt, 1),
         "unit": "agent steps/s (repeat 2)",
         "num_envs": num_envs,
+        "threads": min(os.cpu_count() or 1, num_envs),
     }
 
 
