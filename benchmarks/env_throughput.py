@@ -98,7 +98,7 @@ def bench_native_pool(domain: str, task: str, num_envs: int, steps: int) -> dict
         "value": round(num_envs * steps / dt, 1),
         "unit": "agent steps/s (repeat 2)",
         "num_envs": num_envs,
-        "threads": min(os.cpu_count() or 1, num_envs),
+        "threads": pool.num_threads,  # resolved by the pool itself
     }
 
 

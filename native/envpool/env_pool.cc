@@ -108,6 +108,7 @@ struct Pool {
   int nsub = 1;        // physics substeps per control step
   int step_limit = 0;  // control steps per episode
   int obs_dim = 0;
+  int n_threads = 1;   // resolved worker count (min(max(1,hw), num_envs))
 
   // Model lookups resolved once at creation.
   int torso_body = -1;
@@ -507,6 +508,7 @@ void* envpool_create(const char* xml_path, int task_id, int num_envs,
   const int hw = static_cast<int>(std::thread::hardware_concurrency());
   int threads = num_threads > 0 ? num_threads : std::max(1, hw);
   threads = std::min(threads, num_envs);
+  p->n_threads = threads;
   if (threads > 1)
     for (int t = 0; t < threads; ++t)
       p->workers.emplace_back([p] { p->WorkerLoop(); });
@@ -520,6 +522,9 @@ int envpool_action_dim(void* h) { return static_cast<Pool*>(h)->model->nu; }
 int envpool_episode_len(void* h) { return static_cast<Pool*>(h)->step_limit; }
 int envpool_nq(void* h) { return static_cast<Pool*>(h)->model->nq; }
 int envpool_nv(void* h) { return static_cast<Pool*>(h)->model->nv; }
+// Resolved worker-thread count — benchmarks divide pool throughput by this
+// for the per-core ceiling rather than re-deriving the formula in Python.
+int envpool_num_threads(void* h) { return static_cast<Pool*>(h)->n_threads; }
 
 void envpool_seed(void* h, const int64_t* seeds) {
   Pool* p = static_cast<Pool*>(h);

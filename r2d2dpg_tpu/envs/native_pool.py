@@ -90,7 +90,9 @@ def load_library() -> ctypes.CDLL:
             ctypes.c_int,
         ]
         lib.envpool_destroy.argtypes = [ctypes.c_void_p]
-        for name in ("obs_dim", "action_dim", "episode_len", "nq", "nv"):
+        for name in (
+            "obs_dim", "action_dim", "episode_len", "nq", "nv", "num_threads"
+        ):
             fn = getattr(lib, f"envpool_{name}")
             fn.restype = ctypes.c_int
             fn.argtypes = [ctypes.c_void_p]
@@ -170,6 +172,9 @@ class NativeEnvPool:
         self.obs_dim = self._lib.envpool_obs_dim(handle)
         self.action_dim = self._lib.envpool_action_dim(handle)
         self.episode_len = self._lib.envpool_episode_len(handle)
+        # Resolved by the pool (min(max(1, hw), num_envs), or the explicit
+        # num_threads) — benchmarks read this instead of re-deriving it.
+        self.num_threads = self._lib.envpool_num_threads(handle)
 
     def close(self) -> None:
         if self._handle:

@@ -162,6 +162,19 @@ EXTRA_FLAGS=""
 [ -f runs/tpu/northstar_extra_flags ] && EXTRA_FLAGS="$(head -1 runs/tpu/northstar_extra_flags)"
 echo "north-star will run with: $NORTHSTAR_FLAGS $EXTRA_FLAGS"
 
+# Checkpoint-shape-affecting flags that eval must repeat to restore a
+# matching template (eval supports exactly these two).
+shape_flags() {
+  python - <<EOF
+toks = """$*""".split()
+out = []
+for i, t in enumerate(toks):
+    if t in ("--twin-critic", "--compute-dtype") and i + 1 < len(toks):
+        out += [t, toks[i + 1]]
+print(" ".join(out))
+EOF
+}
+
 # ----------------------------------------------------------- steps 2 + 3
 # One 30-min walker train + deterministic eval; $1 = run name,
 # $2.. = extra train flags.  .done requires rc=0 AND an on-chip backend
@@ -175,11 +188,14 @@ run_walker() {
     echo "--- $name: walker 30 min on TPU ($*) $(date) ---"
     rm -rf "runs/tpu/$name"
     mkdir -p "runs/tpu/$name"
+    # Flag precedence (argparse last-wins): fixed defaults < chosen
+    # overlap flags < generic drop-in < this run's own flags ("$@" last so
+    # the drop-in cannot clobber what distinguishes walker30_bf16).
     timeout --kill-after=60 --signal=TERM 2700 python -m r2d2dpg_tpu.train --config walker_r2d2 \
-      $NORTHSTAR_FLAGS $EXTRA_FLAGS "$@" --num-envs 64 --batch-size 64 \
+      --num-envs 64 --batch-size 64 \
       --minutes 30 --log-every 10 --eval-every 200 --eval-envs 5 \
       --logdir "runs/tpu/$name" --checkpoint-dir "runs/tpu/$name/ckpt" \
-      --checkpoint-every 200 | tail -40
+      --checkpoint-every 200 $NORTHSTAR_FLAGS $EXTRA_FLAGS "$@" | tail -40
     local rc=$?
     bail_if_wedged $rc "$name"
     if [ $rc -eq 0 ] && train_backend_ok "runs/tpu/$name"; then
@@ -195,8 +211,12 @@ run_walker() {
     echo "--- $name eval: artifact exists, skipping $(date) ---"
   elif [ -d "runs/tpu/$name/ckpt" ] && [ -n "$(ls runs/tpu/$name/ckpt 2>/dev/null)" ]; then
     echo "--- $name deterministic eval $(date) ---"
+    # Repeat the shape-affecting train flags (drop-in first, "$@" last to
+    # match the train command's precedence) or the restore template won't
+    # match the checkpoint tree.
     timeout --kill-after=30 --signal=TERM 900 python -m r2d2dpg_tpu.eval --config walker_r2d2 \
-      "$@" --checkpoint-dir "runs/tpu/$name/ckpt" --episodes 10 --rounds 2 \
+      $(shape_flags $EXTRA_FLAGS "$@") \
+      --checkpoint-dir "runs/tpu/$name/ckpt" --episodes 10 --rounds 2 \
       | tee "runs/tpu/${name}_eval.jsonl"
     local rc=$?
     bail_if_wedged $rc "${name}_eval"
@@ -227,9 +247,9 @@ run_curve() {
   rm -rf "runs/tpu/$name"
   mkdir -p "runs/tpu/$name"
   timeout --kill-after=60 --signal=TERM 6900 python -m r2d2dpg_tpu.train --config "$config" \
-    "$@" --minutes 100 --log-every 10 --eval-every 150 --eval-envs 3 \
+    --minutes 100 --log-every 10 --eval-every 150 --eval-envs 3 \
     --logdir "runs/tpu/$name" --checkpoint-dir "runs/tpu/$name/ckpt" \
-    --checkpoint-every 100 | tail -30
+    --checkpoint-every 100 "$@" | tail -30
   local rc=$?
   bail_if_wedged $rc "$name"
   if [ $rc -eq 0 ] && train_backend_ok "runs/tpu/$name"; then
