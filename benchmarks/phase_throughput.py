@@ -11,12 +11,17 @@ updates) at walker_r2d2 shapes, in three modes:
                      (TrainerConfig.overlap_learner): on a real TPU the
                      updates hide under the MuJoCo C step.
 
-Prints one JSON line per mode with phases/s, agent-steps/s and
-learner-steps/s.  Runs on whatever backend JAX resolves (TPU when the
-tunnel is up; CPU otherwise — on CPU 'overlap' cannot win since host and
-device share the single core; the number that transfers is the TPU one).
+Prints one JSON line per row: the three modes above, plus one extra
+``overlap_ls<K>`` row per requested extra density (4th argv) — on-chip
+the learner is nearly free, so the question the extra rows answer is how
+many interleaved updates per phase the rate sustains.  Runs on whatever
+backend JAX resolves (TPU when the tunnel is up; CPU otherwise — on CPU
+'overlap' cannot win since host and device share the single core; the
+number that transfers is the TPU one).
 
-Usage: python benchmarks/phase_throughput.py [num_envs] [phases] [learner_steps]
+Usage:
+  python benchmarks/phase_throughput.py [num_envs] [phases] [learner_steps] \
+      [extra_overlap_densities_csv]     # e.g. 64 12 48 192
 """
 
 from __future__ import annotations
@@ -90,12 +95,26 @@ def main() -> None:
     num_envs = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     phases = int(sys.argv[2]) if len(sys.argv) > 2 else 20
     learner_steps = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    # Optional comma-separated EXTRA overlap densities (e.g. "192"): on-chip
+    # the learner is ~free (15k steps/s), so the binding question for the
+    # north star is how many interleaved updates the phase rate sustains —
+    # each extra density adds one overlap row named overlap_ls<K>.
+    extra_overlap = (
+        [int(x) for x in sys.argv[4].split(",") if x]
+        if len(sys.argv) > 4
+        else []
+    )
 
     t = build(num_envs, learner_steps, overlap=False)
     print(json.dumps(measure(t, phases, "collect")), flush=True)
     print(json.dumps(measure(t, phases, "sequential")), flush=True)
     t = build(num_envs, learner_steps, overlap=True)
     print(json.dumps(measure(t, phases, "overlap")), flush=True)
+    for k in extra_overlap:
+        t = build(num_envs, k, overlap=True)
+        row = measure(t, phases, "overlap")
+        row["metric"] = f"walker_phase_throughput_overlap_ls{k}"
+        print(json.dumps(row), flush=True)
 
 
 if __name__ == "__main__":

@@ -127,9 +127,12 @@ run_bench() {
 }
 
 # --------------------------------------------------------------- step 1
-# Overlap proof at walker shapes (64 envs / stride 20 / 48 learner steps).
-run_bench runs/tpu/phase_throughput.json phase_throughput 1500 \
-  python benchmarks/phase_throughput.py 64 12 48
+# Overlap proof at walker shapes (64 envs / stride 20 / 48 learner steps),
+# plus a 192-density overlap row — on-chip the learner is ~free, so if the
+# phase rate holds at 192 interleaved updates the north star runs at
+# ratio ~1:7 instead of 1:26.
+run_bench runs/tpu/phase_throughput.json phase_throughput 1800 \
+  python benchmarks/phase_throughput.py 64 12 48 192
 
 # Pick north-star flags from the on-chip measurement (sequential-48
 # fallback — see header).  Only a tpu/axon-backend artifact counts.
@@ -143,14 +146,17 @@ try:
         for line in f:
             if line.strip():
                 r = json.loads(line)
-                rows[r["metric"].rsplit("_", 1)[-1]] = r
-    assert all(r.get("backend") in ("tpu", "axon") for r in rows.values())
-    col = rows["collect"]["phases_per_sec"]
-    seq = rows["sequential"]["phases_per_sec"]
-    ovl = rows["overlap"]["phases_per_sec"]
+                key = r["metric"].split("walker_phase_throughput_", 1)[-1]
+                rows[key] = r["phases_per_sec"]
+                assert r.get("backend") in ("tpu", "axon"), r
+    col, seq, ovl = rows["collect"], rows["sequential"], rows["overlap"]
     if ovl >= 0.95 * seq:
         flags = "--overlap-learner 1 --learner-steps 48"
-    why = f"measured on-chip collect={col} seq={seq} overlap={ovl} phases/s"
+        # Densest sustainable overlap wins: 192 interleaved updates if the
+        # phase rate holds within 10% of overlap-48.
+        if rows.get("overlap_ls192", 0) >= 0.9 * ovl:
+            flags = "--overlap-learner 1 --learner-steps 192"
+    why = f"measured on-chip phases/s: {rows}"
 except Exception as e:  # noqa: BLE001 — missing/partial/CPU artifact
     why = f"no usable on-chip measurement ({e}); using documented fallback"
 with open("runs/tpu/northstar_flags", "w") as f:
@@ -163,10 +169,15 @@ EXTRA_FLAGS=""
 echo "north-star will run with: $NORTHSTAR_FLAGS $EXTRA_FLAGS"
 
 # Checkpoint-shape-affecting flags that eval must repeat to restore a
-# matching template (eval supports exactly these two).
+# matching template (eval supports exactly these two).  Flags arrive as
+# argv (no shell-into-python interpolation) and both argparse spellings
+# ("--flag value" and "--flag=value") are recognized.
 shape_flags() {
-  python - <<EOF
-toks = """$*""".split()
+  python - "$@" <<'EOF'
+import sys
+toks = []
+for t in sys.argv[1:]:
+    toks.extend(t.split("=", 1) if t.startswith("--") and "=" in t else [t])
 out = []
 for i, t in enumerate(toks):
     if t in ("--twin-critic", "--compute-dtype") and i + 1 < len(toks):
@@ -188,14 +199,18 @@ run_walker() {
     echo "--- $name: walker 30 min on TPU ($*) $(date) ---"
     rm -rf "runs/tpu/$name"
     mkdir -p "runs/tpu/$name"
-    # Flag precedence (argparse last-wins): fixed defaults < chosen
-    # overlap flags < generic drop-in < this run's own flags ("$@" last so
-    # the drop-in cannot clobber what distinguishes walker30_bf16).
+    # Flag precedence (argparse last-wins): tunable defaults < chosen
+    # overlap flags < generic drop-in < this run's own flags ("$@" so the
+    # drop-in cannot clobber what distinguishes walker30_bf16) < the
+    # INFRASTRUCTURE flags, which stay last so no drop-in can redirect
+    # --logdir/--minutes/--checkpoint-dir out from under the step's
+    # timeout bound and backend gate.
     timeout --kill-after=60 --signal=TERM 2700 python -m r2d2dpg_tpu.train --config walker_r2d2 \
       --num-envs 64 --batch-size 64 \
+      $NORTHSTAR_FLAGS $EXTRA_FLAGS "$@" \
       --minutes 30 --log-every 10 --eval-every 200 --eval-envs 5 \
       --logdir "runs/tpu/$name" --checkpoint-dir "runs/tpu/$name/ckpt" \
-      --checkpoint-every 200 $NORTHSTAR_FLAGS $EXTRA_FLAGS "$@" | tail -40
+      --checkpoint-every 200 | tail -40
     local rc=$?
     bail_if_wedged $rc "$name"
     if [ $rc -eq 0 ] && train_backend_ok "runs/tpu/$name"; then
@@ -246,10 +261,13 @@ run_curve() {
   echo "--- $name ($config: $*) $(date) ---"
   rm -rf "runs/tpu/$name"
   mkdir -p "runs/tpu/$name"
+  # Tunables ("$@", incl. any drop-in) first; infrastructure flags last
+  # and un-clobberable (same rationale as run_walker).
   timeout --kill-after=60 --signal=TERM 6900 python -m r2d2dpg_tpu.train --config "$config" \
+    "$@" \
     --minutes 100 --log-every 10 --eval-every 150 --eval-envs 3 \
     --logdir "runs/tpu/$name" --checkpoint-dir "runs/tpu/$name/ckpt" \
-    --checkpoint-every 100 "$@" | tail -30
+    --checkpoint-every 100 | tail -30
   local rc=$?
   bail_if_wedged $rc "$name"
   if [ $rc -eq 0 ] && train_backend_ok "runs/tpu/$name"; then
