@@ -43,6 +43,20 @@ class ExperimentConfig:
     def build(self) -> Trainer:
         env = self.env_factory()
         agent = self.build_agent(env)
+        if self.trainer.overlap_learner:
+            # The interleaved-learner path lives in HostSPMDTrainer (the
+            # updates hide under the host env pool's MuJoCo step); on one
+            # device that trainer degenerates cleanly to a 1-mesh.  The
+            # base Trainer would silently ignore the flag — refuse to.
+            if not getattr(env, "batched", False):
+                raise ValueError(
+                    "overlap_learner requires a host-pool env (pure-JAX "
+                    "envs collect in-graph; there is no host gap to hide "
+                    "updates under)"
+                )
+            from r2d2dpg_tpu.parallel import HostSPMDTrainer, make_mesh
+
+            return HostSPMDTrainer(env, agent, self.trainer, make_mesh(1))
         return Trainer(env, agent, self.trainer)
 
     def build_agent(self, env: Environment, axis_name=None) -> R2D2DPG:
@@ -79,6 +93,12 @@ class ExperimentConfig:
         if getattr(env, "batched", False):
             agent = self.build_agent(env, axis_name=None)
             return HostSPMDTrainer(env, agent, self.trainer, mesh)
+        if self.trainer.overlap_learner:
+            raise ValueError(
+                "overlap_learner requires a host-pool env (pure-JAX envs "
+                "collect in-graph; there is no host gap to hide updates "
+                "under) — SPMDTrainer would silently ignore it"
+            )
         agent = self.build_agent(env, axis_name=DP_AXIS)
         return SPMDTrainer(env, agent, self.trainer, mesh)
 

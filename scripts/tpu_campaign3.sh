@@ -130,7 +130,14 @@ run_bench() {
 # Overlap proof at walker shapes (64 envs / stride 20 / 48 learner steps),
 # plus a 192-density overlap row — on-chip the learner is ~free, so if the
 # phase rate holds at 192 interleaved updates the north star runs at
-# ratio ~1:7 instead of 1:26.
+# ratio ~1:7 instead of 1:26.  An artifact from an older campaign pass
+# that predates the 192 row is stale — without this, run_bench would skip
+# the re-measure and the flag picker could never choose the density.
+if [ -s runs/tpu/phase_throughput.json ] \
+   && ! grep -q overlap_ls192 runs/tpu/phase_throughput.json; then
+  echo "phase_throughput artifact lacks the overlap_ls192 row; re-measuring"
+  rm -f runs/tpu/phase_throughput.json
+fi
 run_bench runs/tpu/phase_throughput.json phase_throughput 1800 \
   python benchmarks/phase_throughput.py 64 12 48 192
 

@@ -171,6 +171,51 @@ def test_knobs_off_is_plain_ddpg_bit_for_bit():
     assert "q_spread" not in m1
 
 
+def test_twin_overlap_hybrid_trainer_smoke():
+    """The campaign's config-#5 on-chip combination: twin critic + overlap
+    learner in the hybrid (host-pool) trainer, via the same build() routing
+    train.py uses without --spmd.  One full interleaved train phase."""
+    import dataclasses
+
+    from r2d2dpg_tpu.configs import WALKER_R2D2
+    from r2d2dpg_tpu.parallel import HostSPMDTrainer
+
+    cfg = dataclasses.replace(
+        WALKER_R2D2,
+        hidden=32,
+        agent=dataclasses.replace(
+            WALKER_R2D2.agent,
+            burnin=2,
+            unroll=4,
+            n_step=2,
+            twin_critic=True,
+            target_policy_sigma=0.2,
+        ),
+        trainer=dataclasses.replace(
+            WALKER_R2D2.trainer,
+            num_envs=2,
+            stride=4,
+            batch_size=2,
+            capacity=16,
+            min_replay=2,
+            learner_steps=2,
+            overlap_learner=True,
+        ),
+    )
+    trainer = cfg.build()
+    assert isinstance(trainer, HostSPMDTrainer)
+    state = trainer.init()
+    for _ in range(trainer.window_fill_phases):
+        state = trainer.collect_phase(state)
+    for _ in range(trainer.replay_fill_phases):
+        state = trainer.fill_phase(state)
+    state, metrics = trainer.train_phase(state)
+    assert int(state.train.step) == 2  # both interleaved updates ran
+    assert "q_spread" in metrics
+    for k, v in metrics.items():
+        assert np.isfinite(float(v)), (k, metrics)
+
+
 def test_twin_initial_priority_and_trainer_smoke():
     """End-to-end: a tiny pendulum trainer with both knobs on runs a full
     train phase with finite metrics (covers the trainer key plumbing)."""
