@@ -78,7 +78,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     )
     # Checkpointing.
     p.add_argument("--checkpoint-dir", default=None)
-    p.add_argument("--checkpoint-every", type=int, default=500, help="phases between checkpoints (0 = off)")
+    p.add_argument(
+        "--checkpoint-every", type=int, default=500,
+        help="phases between checkpoints (0 = off entirely; -1 = final-"
+        "save-only, e.g. for measurement runs where periodic saves would "
+        "drag the GB-scale replay arena device->host mid-run)"
+    )
     p.add_argument("--resume", action="store_true", help="resume from the latest checkpoint in --checkpoint-dir")
     # Evaluation.
     p.add_argument("--eval-every", type=int, default=0, help="train phases between deterministic evals (0 = off)")

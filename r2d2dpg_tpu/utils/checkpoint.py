@@ -64,6 +64,9 @@ class CheckpointManager:
         )
 
     # ------------------------------------------------------------------ save
+    # ``save_every`` semantics: N>0 = every N phases (+ the caller's final
+    # save); -1 = final-save-only (maybe_save never fires, but the truthy
+    # value keeps train.py's finally-block save armed); 0 = off entirely.
     def maybe_save(self, phase: int, state: Any) -> bool:
         """Save if ``phase`` hits the cadence.  Returns True when saved."""
         if self.save_every <= 0 or phase % self.save_every != 0:

@@ -212,12 +212,18 @@ run_walker() {
     # INFRASTRUCTURE flags, which stay last so no drop-in can redirect
     # --logdir/--minutes/--checkpoint-dir out from under the step's
     # timeout bound and backend gate.
+    # checkpoint-every -1 = final-save-only: a periodic save drags the
+    # ~1 GB TrainerState (replay arena included) device->host through the
+    # tunnel and would eat minutes of the 30-min measurement; train.py's
+    # finally-block still writes one full checkpoint after the deadline,
+    # which is all the deterministic eval needs.  Wedged runs restart
+    # clean anyway (see rm -rf above).
     timeout --kill-after=60 --signal=TERM 2700 python -m r2d2dpg_tpu.train --config walker_r2d2 \
       --num-envs 64 --batch-size 64 \
       $NORTHSTAR_FLAGS $EXTRA_FLAGS "$@" \
       --minutes 30 --log-every 10 --eval-every 200 --eval-envs 5 \
       --logdir "runs/tpu/$name" --checkpoint-dir "runs/tpu/$name/ckpt" \
-      --checkpoint-every 200 | tail -40
+      --checkpoint-every -1 | tail -40
     local rc=$?
     bail_if_wedged $rc "$name"
     if [ $rc -eq 0 ] && train_backend_ok "runs/tpu/$name"; then
@@ -269,12 +275,14 @@ run_curve() {
   rm -rf "runs/tpu/$name"
   mkdir -p "runs/tpu/$name"
   # Tunables ("$@", incl. any drop-in) first; infrastructure flags last
-  # and un-clobberable (same rationale as run_walker).
+  # and un-clobberable (same rationale as run_walker).  Final-save-only
+  # checkpointing: the pixel/humanoid arenas are GBs, and these steps'
+  # deliverable is the metrics.csv learning curve, not mid-run resume.
   timeout --kill-after=60 --signal=TERM 6900 python -m r2d2dpg_tpu.train --config "$config" \
     "$@" \
     --minutes 100 --log-every 10 --eval-every 150 --eval-envs 3 \
     --logdir "runs/tpu/$name" --checkpoint-dir "runs/tpu/$name/ckpt" \
-    --checkpoint-every 100 | tail -30
+    --checkpoint-every -1 | tail -30
   local rc=$?
   bail_if_wedged $rc "$name"
   if [ $rc -eq 0 ] && train_backend_ok "runs/tpu/$name"; then
