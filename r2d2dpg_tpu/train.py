@@ -84,6 +84,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         "save-only, e.g. for measurement runs where periodic saves would "
         "drag the GB-scale replay arena device->host mid-run)"
     )
+    p.add_argument(
+        "--checkpoint-light", action="store_true",
+        help="save only the learner subtree (params/targets/opt/step): MBs "
+        "instead of GBs, eval-compatible; resume restarts replay fresh"
+    )
     p.add_argument("--resume", action="store_true", help="resume from the latest checkpoint in --checkpoint-dir")
     # Evaluation.
     p.add_argument("--eval-every", type=int, default=0, help="train phases between deterministic evals (0 = off)")
@@ -170,7 +175,9 @@ def run(args) -> dict:
     ckpt: Optional[CheckpointManager] = None
     if args.checkpoint_dir:
         ckpt = CheckpointManager(
-            args.checkpoint_dir, save_every=args.checkpoint_every
+            args.checkpoint_dir,
+            save_every=args.checkpoint_every,
+            light=args.checkpoint_light,
         )
 
     evaluator: Optional[Evaluator] = None
@@ -270,7 +277,7 @@ def run(args) -> dict:
             profiler_cm.__exit__(None, None, None)
         if ckpt is not None:
             if ckpt.save_every:
-                ckpt.save(phase, state)
+                ckpt.save_final(phase, state)
             ckpt.wait()
             ckpt.close()
         logger.close()

@@ -42,7 +42,16 @@ class CheckpointManager:
         save_every: int = 500,
         max_to_keep: int = 3,
         async_save: bool = False,
+        light: bool = False,
     ):
+        # ``light``: save only the learner subtree ({"train": state.train} —
+        # params, targets, optimizer states, step) instead of the full
+        # TrainerState.  MBs instead of GBs (no replay arena / window /
+        # env fleet), so periodic saves are affordable mid-measurement,
+        # and the on-disk layout is exactly what eval.py restores.  Resume
+        # from a light checkpoint continues learning with a FRESH replay
+        # and phase schedule (warm-up/fill re-run) — by design.
+        self.light = light
         # orbax rejects relative paths at SAVE time (deep inside the first
         # cadence hit — a run can train for minutes and then die); absolutize
         # up front so `--checkpoint-dir runs/x/ckpt` just works.
@@ -75,7 +84,47 @@ class CheckpointManager:
         return True
 
     def save(self, step: int, state: Any) -> None:
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        """Save at ``step``, overwriting an existing same-step checkpoint
+        (a light-resume run restarts its phase numbering at 0, so a
+        resumed run legitimately revisits steps already on disk)."""
+        self._check_layout(saving=True)
+        if step in (self._mgr.all_steps() or []):
+            self._mgr.delete(step)
+        payload = {"train": state.train} if self.light else state
+        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+
+    def save_final(self, step: int, state: Any) -> None:
+        """End-of-run save; no-op when the cadence already saved ``step``
+        (orbax raises StepAlreadyExistsError otherwise, which would turn a
+        successful run into a failed one at teardown)."""
+        if self._mgr.latest_step() == step:
+            return
+        self.save(step, state)
+
+    _LAYOUT_MARKER = "LIGHT_CHECKPOINTS"
+
+    def _check_layout(self, *, saving: bool) -> None:
+        """Refuse light/full mode mismatches against what's on disk, with a
+        clear message instead of an opaque orbax tree-structure error."""
+        marker = os.path.join(self.directory, self._LAYOUT_MARKER)
+        has_steps = bool(self._mgr.all_steps())
+        if self.light:
+            if has_steps and not os.path.exists(marker):
+                raise ValueError(
+                    f"{self.directory} holds FULL checkpoints but this "
+                    "manager is light=True — drop --checkpoint-light or "
+                    "point at a fresh directory"
+                )
+            if saving and not os.path.exists(marker):
+                with open(marker, "w") as f:
+                    f.write("train-subtree-only checkpoints\n")
+        elif os.path.exists(marker):
+            raise ValueError(
+                f"{self.directory} holds LIGHT checkpoints but this "
+                "manager is light=False — pass --checkpoint-light to match "
+                "(eval.py is unaffected: it restores the train subtree "
+                "from either layout)"
+            )
 
     def wait(self) -> None:
         """Block until async saves are durable (call before process exit)."""
@@ -91,22 +140,27 @@ class CheckpointManager:
 
         ``template`` is a concrete ``TrainerState`` (e.g. ``trainer.init()``)
         — its shapes/dtypes/shardings define the restore target, so restored
-        arrays land with the same mesh layout the trainer expects.
+        arrays land with the same mesh layout the trainer expects.  In
+        ``light`` mode only the learner subtree is stored, so the template
+        is narrowed to it and the result is the restored ``train`` subtree.
         """
+        self._check_layout(saving=False)
         step = self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}"
             )
+        target = {"train": template.train} if self.light else template
         abstract = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(
                 jnp.shape(x), x.dtype, sharding=getattr(x, "sharding", None)
             )
             if isinstance(x, (jax.Array, np.ndarray))
             else x,
-            template,
+            target,
         )
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        out = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        return out["train"] if self.light else out
 
     def close(self) -> None:
         self._mgr.close()
@@ -120,8 +174,14 @@ def resume_state(trainer, ckpt: CheckpointManager):
     slice of the state — env_state/obs/reset/carries/noise/episode_return and
     the assembler window — is taken fresh from ``trainer.init()`` while
     learner/replay/counters come from the checkpoint.
+
+    Light checkpoints carry only the learner subtree: everything else
+    (replay, window, env fleet, phase schedule) starts fresh and the
+    warm-up/fill phases re-run — learning continues, experience restarts.
     """
     fresh = trainer.init()
+    if ckpt.light:
+        return dataclasses.replace(fresh, train=ckpt.restore(fresh))
     restored = ckpt.restore(fresh)
     if not getattr(trainer.env, "batched", False):
         return restored

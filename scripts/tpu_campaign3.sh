@@ -196,15 +196,20 @@ EOF
 # ----------------------------------------------------------- steps 2 + 3
 # One 30-min walker train + deterministic eval; $1 = run name,
 # $2.. = extra train flags.  .done requires rc=0 AND an on-chip backend
-# stamp; a partial/CPU run is wiped so a re-fire restarts it cleanly
-# (wall-clock purity: never resume a partial 30-min measurement).
+# stamp; a partial/CPU run is moved aside (forensics) and the re-fire
+# starts a fresh directory (wall-clock purity: never resume a partial
+# 30-min measurement).
 run_walker() {
   local name=$1; shift
   if [ -f "runs/tpu/$name/.done" ]; then
     echo "--- $name: already done, skipping $(date) ---"
   else
     echo "--- $name: walker 30 min on TPU ($*) $(date) ---"
-    rm -rf "runs/tpu/$name"
+    # Preserve a wedge-interrupted partial (its metrics.csv is evidence)
+    # rather than deleting it; the fresh run still starts clean.
+    if [ -d "runs/tpu/$name" ]; then
+      mv "runs/tpu/$name" "runs/tpu/$name.partial.$(date +%s)"
+    fi
     mkdir -p "runs/tpu/$name"
     # Flag precedence (argparse last-wins): tunable defaults < chosen
     # overlap flags < generic drop-in < this run's own flags ("$@" so the
@@ -212,25 +217,24 @@ run_walker() {
     # INFRASTRUCTURE flags, which stay last so no drop-in can redirect
     # --logdir/--minutes/--checkpoint-dir out from under the step's
     # timeout bound and backend gate.
-    # checkpoint-every -1 = final-save-only: a periodic save drags the
-    # ~1 GB TrainerState (replay arena included) device->host through the
-    # tunnel and would eat minutes of the 30-min measurement; train.py's
-    # finally-block still writes one full checkpoint after the deadline,
-    # which is all the deterministic eval needs.  Wedged runs restart
-    # clean anyway (see rm -rf above).
+    # checkpoint-every -1 + light = ONE learner-subtree save at the
+    # deadline (MBs): periodic/full saves would drag the ~1 GB
+    # TrainerState (replay arena included) device->host through the
+    # tunnel mid-measurement, and the deterministic eval restores only
+    # the learner subtree anyway.  Wedged/failed runs are moved aside, not deleted.
     timeout --kill-after=60 --signal=TERM 2700 python -m r2d2dpg_tpu.train --config walker_r2d2 \
       --num-envs 64 --batch-size 64 \
       $NORTHSTAR_FLAGS $EXTRA_FLAGS "$@" \
       --minutes 30 --log-every 10 --eval-every 200 --eval-envs 5 \
       --logdir "runs/tpu/$name" --checkpoint-dir "runs/tpu/$name/ckpt" \
-      --checkpoint-every -1 | tail -40
+      --checkpoint-every -1 --checkpoint-light | tail -40
     local rc=$?
     bail_if_wedged $rc "$name"
     if [ $rc -eq 0 ] && train_backend_ok "runs/tpu/$name"; then
       touch "runs/tpu/$name/.done"
     else
-      echo "$name FAILED (rc=$rc, backend=$(cat runs/tpu/$name/backend.txt 2>/dev/null || echo none)); wiping for clean re-fire"
-      rm -rf "runs/tpu/$name"
+      echo "$name FAILED (rc=$rc, backend=$(cat runs/tpu/$name/backend.txt 2>/dev/null || echo none)); preserving partial for forensics"
+      mv "runs/tpu/$name" "runs/tpu/$name.failed.$(date +%s)"
     fi
     sleep 60
   fi
@@ -272,7 +276,9 @@ run_curve() {
     return
   fi
   echo "--- $name ($config: $*) $(date) ---"
-  rm -rf "runs/tpu/$name"
+  if [ -d "runs/tpu/$name" ]; then
+    mv "runs/tpu/$name" "runs/tpu/$name.partial.$(date +%s)"
+  fi
   mkdir -p "runs/tpu/$name"
   # Tunables ("$@", incl. any drop-in) first; infrastructure flags last
   # and un-clobberable (same rationale as run_walker).  Final-save-only
@@ -282,14 +288,14 @@ run_curve() {
     "$@" \
     --minutes 100 --log-every 10 --eval-every 150 --eval-envs 3 \
     --logdir "runs/tpu/$name" --checkpoint-dir "runs/tpu/$name/ckpt" \
-    --checkpoint-every -1 | tail -30
+    --checkpoint-every 300 --checkpoint-light | tail -30
   local rc=$?
   bail_if_wedged $rc "$name"
   if [ $rc -eq 0 ] && train_backend_ok "runs/tpu/$name"; then
     touch "runs/tpu/$name/.done"
   else
-    echo "$name FAILED (rc=$rc, backend=$(cat runs/tpu/$name/backend.txt 2>/dev/null || echo none)); wiping for clean re-fire"
-    rm -rf "runs/tpu/$name"
+    echo "$name FAILED (rc=$rc, backend=$(cat runs/tpu/$name/backend.txt 2>/dev/null || echo none)); preserving partial for forensics"
+    mv "runs/tpu/$name" "runs/tpu/$name.failed.$(date +%s)"
   fi
   sleep 60
 }

@@ -104,6 +104,65 @@ def test_checkpoint_roundtrip_and_resume(tmp_path):
     ckpt.close()
 
 
+def test_light_checkpoint_roundtrip_resume_and_eval(tmp_path):
+    """Light mode stores only the learner subtree: resume_state grafts it
+    onto a fresh state (replay/schedule restart), and eval's
+    _restore_learner reads it exactly like a full checkpoint."""
+    from r2d2dpg_tpu.eval import _restore_learner
+
+    trainer = PENDULUM_TINY.build()
+    state = trainer.init()
+    for _ in range(trainer.window_fill_phases):
+        state = trainer.collect_phase(state)
+    state = trainer.fill_phase(state)
+    state, _ = trainer.train_phase(state)
+
+    ckpt = CheckpointManager(
+        str(tmp_path / "light"), save_every=1, light=True
+    )
+    ckpt.save(1, state)
+    ckpt.wait()
+
+    resumed = resume_state(trainer, ckpt)
+    _tree_allclose(resumed.train, state.train)  # learner restored...
+    assert int(resumed.phase_idx) == 0  # ...schedule/replay fresh
+    assert int(trainer.arena.size(resumed.arena)) == 0
+    ckpt.close()
+
+    train = _restore_learner(trainer, str(tmp_path / "light"))
+    _tree_allclose(train, state.train)
+
+
+def test_checkpoint_same_step_overwrite_final_skip_and_layout_guards(tmp_path):
+    """save() overwrites a same-step checkpoint (light-resume runs restart
+    phase numbering); save_final() no-ops on an already-saved step instead
+    of letting orbax StepAlreadyExistsError fail a finished run; light/full
+    layout mismatches raise a clear error, not an orbax tree mismatch."""
+    trainer = PENDULUM_TINY.build()
+    state = trainer.init()
+
+    d = str(tmp_path / "full")
+    ck = CheckpointManager(d, save_every=1)
+    ck.save(2, state)
+    ck.save_final(2, state)  # cadence already saved step 2: must no-op
+    ck.save(2, state)  # same-step overwrite: must not raise
+    ck.wait()
+    assert ck.latest_step == 2
+    ck.close()
+
+    with pytest.raises(ValueError, match="FULL"):
+        lt = CheckpointManager(d, save_every=1, light=True)
+        lt.save(3, state)
+
+    d2 = str(tmp_path / "light")
+    l2 = CheckpointManager(d2, save_every=1, light=True)
+    l2.save(1, state)
+    l2.wait()
+    l2.close()
+    with pytest.raises(ValueError, match="LIGHT"):
+        CheckpointManager(d2, save_every=1).restore(state)
+
+
 def test_restore_learner_roundtrip(tmp_path):
     """_restore_learner's partial restore must return the saved learner
     subtree bit-for-bit (ADVICE r1: pin the orbax dict/dataclass key
