@@ -319,6 +319,12 @@ for a in runs/tpu/phase_throughput.json runs/tpu/walker30/.done \
          runs/tpu/cheetah_pixels/.done runs/tpu/humanoid/.done; do
   [ -e "$a" ] || { echo "missing artifact: $a"; ALL_DONE=0; }
 done
+# Resume the preempted CPU evidence queue (walker_probe was in VICTIMS;
+# it skips probes whose artifacts already landed).  The cheetah/bf16
+# drivers survive preemption on their own retry loops.
+pgrep -f "walker_probe\.sh" > /dev/null \
+  || setsid nohup bash "$HERE/walker_probe.sh" > /dev/null 2>&1 < /dev/null &
+
 if [ "$ALL_DONE" -eq 1 ]; then
   touch runs/tpu/campaign3.complete
   echo "=== TPU campaign3 COMPLETE $(date) ==="
