@@ -79,6 +79,11 @@ bail_if_wedged() {
       touch runs/tpu/campaign3.complete
       echo "=== TPU campaign3 wedge budget spent; giving up $(date) ==="
     fi
+    # The tunnel may stay down for hours — give the single core back to
+    # the preempted CPU evidence queue in the meantime (the next re-fire
+    # preempts it again).
+    pgrep -f "walker_probe\.sh" > /dev/null \
+      || setsid nohup bash "$HERE/walker_probe.sh" > /dev/null 2>&1 < /dev/null &
     echo "=== TPU campaign3 ABORT $(date) ==="
     exit 1
   fi
@@ -281,9 +286,10 @@ run_curve() {
   fi
   mkdir -p "runs/tpu/$name"
   # Tunables ("$@", incl. any drop-in) first; infrastructure flags last
-  # and un-clobberable (same rationale as run_walker).  Final-save-only
-  # checkpointing: the pixel/humanoid arenas are GBs, and these steps'
-  # deliverable is the metrics.csv learning curve, not mid-run resume.
+  # and un-clobberable (same rationale as run_walker).  Periodic LIGHT
+  # checkpoints (learner subtree, MBs): the pixel/humanoid arenas are GBs
+  # and the deliverable is the metrics.csv curve — light saves add wedge
+  # resilience and post-hoc eval without the arena transfer cost.
   timeout --kill-after=60 --signal=TERM 6900 python -m r2d2dpg_tpu.train --config "$config" \
     "$@" \
     --minutes 100 --log-every 10 --eval-every 150 --eval-envs 3 \
