@@ -68,6 +68,14 @@ sleep 60
 # separately from failed full passes: rc 137 can also be a persistent
 # non-tunnel failure (e.g. OOM at the same step every time), so after
 # MAX_WEDGES aborts the campaign gives up rather than re-firing forever.
+# Re-arm the preempted CPU evidence queue (walker_probe was in VICTIMS; it
+# skips probes whose artifacts already landed; the cheetah/bf16 drivers
+# survive preemption on their own retry loops).
+resume_cpu_queue() {
+  pgrep -f "walker_probe\.sh" > /dev/null \
+    || setsid nohup bash "$HERE/walker_probe.sh" > /dev/null 2>&1 < /dev/null &
+}
+
 MAX_WEDGES=8
 bail_if_wedged() {
   local rc=$1 step=$2
@@ -80,10 +88,8 @@ bail_if_wedged() {
       echo "=== TPU campaign3 wedge budget spent; giving up $(date) ==="
     fi
     # The tunnel may stay down for hours — give the single core back to
-    # the preempted CPU evidence queue in the meantime (the next re-fire
-    # preempts it again).
-    pgrep -f "walker_probe\.sh" > /dev/null \
-      || setsid nohup bash "$HERE/walker_probe.sh" > /dev/null 2>&1 < /dev/null &
+    # the CPU evidence queue meanwhile (the next re-fire preempts it again).
+    resume_cpu_queue
     echo "=== TPU campaign3 ABORT $(date) ==="
     exit 1
   fi
@@ -325,11 +331,7 @@ for a in runs/tpu/phase_throughput.json runs/tpu/walker30/.done \
          runs/tpu/cheetah_pixels/.done runs/tpu/humanoid/.done; do
   [ -e "$a" ] || { echo "missing artifact: $a"; ALL_DONE=0; }
 done
-# Resume the preempted CPU evidence queue (walker_probe was in VICTIMS;
-# it skips probes whose artifacts already landed).  The cheetah/bf16
-# drivers survive preemption on their own retry loops.
-pgrep -f "walker_probe\.sh" > /dev/null \
-  || setsid nohup bash "$HERE/walker_probe.sh" > /dev/null 2>&1 < /dev/null &
+resume_cpu_queue
 
 if [ "$ALL_DONE" -eq 1 ]; then
   touch runs/tpu/campaign3.complete

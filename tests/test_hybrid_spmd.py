@@ -89,6 +89,21 @@ def test_hybrid_overlap_learner_path():
     assert int(state.train.step) == 6
 
 
+def test_hybrid_overlap_denser_than_stride():
+    """learner_steps > stride (the campaign's ls192-over-stride-20 regime,
+    scaled down): the even-spread dispatcher must run multiple updates per
+    env-step gap and still complete exactly learner_steps of them."""
+    trainer = make_trainer(overlap_learner=True, learner_steps=9)  # stride 4
+    state = trainer.init()
+    for _ in range(trainer.window_fill_phases):
+        state = trainer.collect_phase(state)
+    state = trainer.fill_phase(state)
+    state, metrics = trainer.train_phase(state)
+    assert int(state.train.step) == 9
+    for k, v in metrics.items():
+        assert np.isfinite(float(v)), (k, metrics)
+
+
 def test_hybrid_per_step_jits_stop_retracing():
     """The host loop dispatches _act_step per env step and _learn_substep per
     learner update; a retrace per step or per phase (e.g. a Python int key
