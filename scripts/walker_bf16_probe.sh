@@ -1,12 +1,16 @@
 #!/bin/bash
 # bf16 learning-parity evidence for config #3 (VERDICT r2 next #7).
 #
-# Mirrors runs/walker_probe_sigma08 EXACTLY (seed 3, 16 envs, 1:20 ratio,
-# 85 min, --sigma-max 0.8) with only --compute-dtype bfloat16 changed, so
-# the two curves are a controlled dtype A/B on walker.  If the bf16 curve
-# matches fp32 (as it did on pendulum, docs/RESULTS.md), WALKER_R2D2's
-# compute_dtype default flips to bfloat16 and bench.py's headline follows
-# (~31k steps/s/chip measured round 2).
+# Mirrors runs/walker_probe_nstep3 — the WINNING plateau probe (final
+# 20-ep eval 351.7 @ ~330k steps; seed 3, 16 envs, 1:20 ratio, 85 min,
+# --n-step 3) — with only --compute-dtype bfloat16 changed, so the two
+# curves are a controlled dtype A/B on the nstep3 recipe (NOT the full
+# north-star flag set: the on-chip run adds --sigma-max 0.8, which has no
+# fp32 control arm at this regime — the dtype call rests on the
+# controlled pair).  If the bf16 curve matches fp32 (as it did on
+# pendulum, docs/RESULTS.md), WALKER_R2D2's compute_dtype default flips
+# to bfloat16 and bench.py's headline follows (~31k steps/s/chip
+# measured round 2).
 #
 # Queued behind the other evidence drivers; preemptible by the TPU
 # campaign (on-chip walker30_bf16 supersedes this CPU A/B).
@@ -34,7 +38,7 @@ for attempt in 1 2 3; do
   nice -n 19 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
   python -m r2d2dpg_tpu.train --config walker_r2d2 --compute-dtype bfloat16 \
     --num-envs 16 --learner-steps 16 --batch-size 64 --min-replay 300 \
-    --sigma-max 0.8 \
+    --n-step 3 \
     --seed 3 --minutes 85 --log-every 10 --eval-every 150 --eval-envs 5 \
     --logdir "$DIR" --checkpoint-dir "$DIR/ckpt" \
     --checkpoint-every 150 > "$DIR/stdout.log" 2>&1
