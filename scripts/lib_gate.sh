@@ -27,6 +27,54 @@ wait_on_box() {
   done
 }
 
+# Shared CPU evidence-run driver: budgeted train + final 20-ep eval +
+# .done stamp, with up to 3 attempts.  A completed training run is never
+# discarded over a transient eval failure: the train step re-runs only
+# when no usable checkpoint exists.
+#   run_evidence <dir> <supersede-artifact|""> <wait-extra-pattern> \
+#                <minutes> <seed> "<eval flags>" <train args...>
+run_evidence() {
+  local dir=$1 supersede=$2 waitpat=$3 minutes=$4 seed=$5 evalflags=$6
+  shift 6
+  local attempt
+  for attempt in 1 2 3; do
+    if [ -f "$dir/.done" ]; then
+      echo "$dir: already done; exiting $(date)"
+      return 0
+    fi
+    if [ -n "$supersede" ] && [ -f "$supersede" ]; then
+      echo "$dir: superseded by $supersede; skipping $(date)"
+      return 0
+    fi
+    wait_on_box "$waitpat"
+    if ! { [ -d "$dir/ckpt" ] && [ -n "$(ls "$dir/ckpt" 2>/dev/null)" ]; }; then
+      echo "=== $dir attempt $attempt train start ($*) $(date) ==="
+      rm -rf "$dir"
+      mkdir -p "$dir"
+      nice -n 19 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+      python -m r2d2dpg_tpu.train "$@" \
+        --seed "$seed" --minutes "$minutes" \
+        --log-every 10 --eval-every 150 --eval-envs 5 \
+        --logdir "$dir" --checkpoint-dir "$dir/ckpt" --checkpoint-every 150 \
+        > "$dir/stdout.log" 2>&1
+      echo "=== $dir attempt $attempt train done rc=$? $(date) ==="
+    else
+      echo "$dir: usable checkpoint exists; retrying eval only $(date)"
+    fi
+    if [ -d "$dir/ckpt" ] && [ -n "$(ls "$dir/ckpt" 2>/dev/null)" ]; then
+      wait_on_box "$waitpat"
+      timeout --kill-after=30 --signal=TERM 1800 \
+        env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+        python -m r2d2dpg_tpu.eval $evalflags \
+          --checkpoint-dir "$dir/ckpt" --episodes 10 --rounds 2 \
+          > "$dir/final_eval.jsonl" 2> "$dir/final_eval.stderr.log" \
+        && tail -1 "$dir/final_eval.jsonl" > "$dir/final_eval.json" \
+        && touch "$dir/.done" \
+        || echo "$dir eval FAILED (attempt $attempt)"
+    fi
+  done
+}
+
 gate_on_box() {
   local artifact="$1" extra="${2:-}"
   while pgrep -f "r2d2dpg_tpu.train" > /dev/null \

@@ -13,46 +13,16 @@
 # measured round 2).
 #
 # Queued behind the other evidence drivers; preemptible by the TPU
-# campaign (on-chip walker30_bf16 supersedes this CPU A/B).
+# campaign (the on-chip walker30_bf16 supersedes this CPU A/B).
 HERE="$(cd "$(dirname "$0")" && pwd)"
 cd "$HERE/.."
 mkdir -p runs
 exec >> runs/walker_bf16_probe.log 2>&1
 source "$HERE/lib_gate.sh" || exit 1
 
-DIR=runs/walker_probe_bf16
-for attempt in 1 2 3; do
-  if [ -f "$DIR/.done" ]; then
-    echo "walker_bf16_probe: already done; exiting $(date)"
-    exit 0
-  fi
-  # The on-chip bf16 run supersedes this CPU A/B entirely.
-  if [ -f runs/tpu/walker30_bf16/.done ]; then
-    echo "walker_bf16_probe: on-chip bf16 walker landed; skipping $(date)"
-    exit 0
-  fi
-  wait_on_box "walker_probe\.sh|cheetah_mitigation\.sh"
-  echo "=== walker_bf16_probe attempt $attempt start $(date) ==="
-  rm -rf "$DIR"
-  mkdir -p "$DIR"
-  nice -n 19 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
-  python -m r2d2dpg_tpu.train --config walker_r2d2 --compute-dtype bfloat16 \
-    --num-envs 16 --learner-steps 16 --batch-size 64 --min-replay 300 \
-    --n-step 3 \
-    --seed 3 --minutes 85 --log-every 10 --eval-every 150 --eval-envs 5 \
-    --logdir "$DIR" --checkpoint-dir "$DIR/ckpt" \
-    --checkpoint-every 150 > "$DIR/stdout.log" 2>&1
-  rc=$?
-  echo "=== walker_bf16_probe attempt $attempt train done rc=$rc $(date) ==="
-  if [ $rc -eq 0 ] && [ -d "$DIR/ckpt" ] && [ -n "$(ls "$DIR/ckpt" 2>/dev/null)" ]; then
-    wait_on_box "walker_probe\.sh|cheetah_mitigation\.sh"
-    timeout --kill-after=30 --signal=TERM 1800 \
-      env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
-      python -m r2d2dpg_tpu.eval --config walker_r2d2 --compute-dtype bfloat16 \
-        --checkpoint-dir "$DIR/ckpt" --episodes 10 --rounds 2 \
-        > "$DIR/final_eval.jsonl" 2> "$DIR/final_eval.stderr.log" \
-      && tail -1 "$DIR/final_eval.jsonl" > "$DIR/final_eval.json" \
-      && touch "$DIR/.done" \
-      || echo "walker_bf16_probe eval FAILED"
-  fi
-done
+run_evidence runs/walker_probe_bf16 runs/tpu/walker30_bf16/.done \
+  "walker_probe\.sh|cheetah_mitigation\.sh" \
+  85 3 "--config walker_r2d2 --compute-dtype bfloat16" \
+  --config walker_r2d2 --compute-dtype bfloat16 \
+  --num-envs 16 --learner-steps 16 --batch-size 64 --min-replay 300 \
+  --n-step 3

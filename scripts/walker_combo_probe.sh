@@ -1,0 +1,21 @@
+#!/bin/bash
+# Combined-recipe confirmation: the exact north-star extra flags
+# (--sigma-max 0.8 --n-step 3) together, fresh seed, same 16-env CPU
+# regime as the probe sweep.  nstep3 alone reached 351.7 @ 330k
+# (runs/walker_probe_nstep3); this run asks whether the combination
+# pushes past 400 on CPU — the literal VERDICT-r2 #5 "walker curve >400"
+# bar — and previews the on-chip walker30 recipe end-to-end.
+# Last in the CPU queue; preemptible by the TPU campaign (the on-chip
+# walker30 supersedes this preview).
+HERE="$(cd "$(dirname "$0")" && pwd)"
+cd "$HERE/.."
+mkdir -p runs
+exec >> runs/walker_combo_probe.log 2>&1
+source "$HERE/lib_gate.sh" || exit 1
+
+run_evidence runs/walker_probe_combo runs/tpu/walker30/.done \
+  "walker_probe\.sh|cheetah_mitigation\.sh|walker_bf16_probe\.sh" \
+  95 4 "--config walker_r2d2" \
+  --config walker_r2d2 \
+  --num-envs 16 --learner-steps 16 --batch-size 64 --min-replay 300 \
+  --sigma-max 0.8 --n-step 3
