@@ -91,6 +91,14 @@ def main() -> None:
             "pre-stamp run)"
         )
 
+    if curve:
+        best = max(curve, key=lambda r: fget(r, ret_key) or float("-inf"))
+        print(
+            f"\nbest: {fget(best, ret_key):.1f} at "
+            f"{(fget(best, 'wall_seconds') or 0) / 60:.0f} min / "
+            f"{fget(best, 'env_steps') or 0:,.0f} steps"
+        )
+
     last = rows[-1]
     bits = []
     for k in ("env_steps_per_sec", "learner_steps_per_sec"):
