@@ -92,7 +92,8 @@ def main() -> None:
         )
 
     if curve:
-        best = max(curve, key=lambda r: fget(r, ret_key) or float("-inf"))
+        # curve rows are pre-filtered to numeric returns — no None guard.
+        best = max(curve, key=lambda r: fget(r, ret_key))
         print(
             f"\nbest: {fget(best, ret_key):.1f} at "
             f"{(fget(best, 'wall_seconds') or 0) / 60:.0f} min / "

@@ -163,13 +163,24 @@ def test_checkpoint_same_step_overwrite_final_skip_and_layout_guards(tmp_path):
         CheckpointManager(d2, save_every=1).restore(state)
 
 
-def test_restore_learner_roundtrip(tmp_path):
+@pytest.mark.parametrize("twin_critic", [False, True])
+def test_restore_learner_roundtrip(tmp_path, twin_critic):
     """_restore_learner's partial restore must return the saved learner
     subtree bit-for-bit (ADVICE r1: pin the orbax dict/dataclass key
-    matching so an orbax upgrade breaking it is caught here, not in eval)."""
+    matching so an orbax upgrade breaking it is caught here, not in eval).
+    Parametrized over twin_critic: the ensemble axis changes the critic
+    tree, and post-hoc eval of a --twin-critic run depends on this path."""
+    import dataclasses
+
     from r2d2dpg_tpu.eval import _restore_learner
 
-    trainer = PENDULUM_TINY.build()
+    cfg = dataclasses.replace(
+        PENDULUM_TINY,
+        agent=dataclasses.replace(
+            PENDULUM_TINY.agent, twin_critic=twin_critic
+        ),
+    )
+    trainer = cfg.build()
     state = trainer.init()
     ckpt = CheckpointManager(str(tmp_path / "ck"), save_every=1)
     ckpt.save(1, state)
