@@ -243,7 +243,12 @@ CHEETAH_PIXELS = ExperimentConfig(
         n_step=5,
         gamma=0.99,
         tau=5e-3,
-        actor_lr=1e-4,
+        # 5e-5 (was 1e-4): the round-2 evidence run collapsed from critic
+        # overestimation at 1e-4 (eval 4.1 -> 1.5 by 94 min); the round-3
+        # run at 5e-5 + batch 16 is monotone 0.8 -> 2.5 -> 4.3 through
+        # 102 min / 76k steps with no collapse (docs/RESULTS.md).  Twin
+        # critic (clipped double-Q) remains the stronger, opt-in fix.
+        actor_lr=5e-5,
         critic_lr=5e-4,
     ),
     trainer=TrainerConfig(
