@@ -30,8 +30,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
         "--compute-dtype", default=None, choices=["float32", "bfloat16"],
-        help="net activation dtype, matching the train-time setting "
-        "(params are float32 either way, so checkpoints restore under both)",
+        help="net activation dtype — MUST match the train-time setting "
+        "(params are float32 either way, but the LSTM cell module differs "
+        "by dtype since round 3's fp32-carry cell, so the param tree "
+        "structure is dtype-specific)",
     )
     p.add_argument(
         "--twin-critic", type=int, default=None, choices=[0, 1],

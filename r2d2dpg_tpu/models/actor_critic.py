@@ -68,6 +68,67 @@ def zeros_where_reset(carry: Carry, reset: jnp.ndarray) -> Carry:
     return jax.tree_util.tree_map(_mask, carry)
 
 
+def _blockwise_orthogonal(n_blocks: int):
+    """Orthogonal init applied per [H, H] gate block (matches flax's
+    per-gate recurrent kernels, so the mixed cell differs from
+    OptimizedLSTMCell ONLY in arithmetic precision, not initialization)."""
+    orth = nn.initializers.orthogonal()
+
+    def init(key, shape, dtype=jnp.float32):
+        h, out = shape
+        assert out == n_blocks * h, shape
+        keys = jax.random.split(key, n_blocks)
+        return jnp.concatenate(
+            [orth(k, (h, h), dtype) for k in keys], axis=1
+        )
+
+    return init
+
+
+class MixedPrecisionLSTMCell(nn.Module):
+    """LSTM cell with ``dtype`` gate matmuls but FLOAT32 state arithmetic.
+
+    Motivation (docs/RESULTS.md round-3 dtype A/B): with flax's cell at
+    ``dtype=bfloat16`` the carry itself is returned in bf16, so the cell
+    state ``c`` accumulates rounding across every unroll step — walker
+    learning fell ~3x behind fp32 while short-horizon pendulum masked it.
+    Here the two gate projections (the MXU work, >95% of the FLOPs) run in
+    ``dtype`` while the state update ``c' = f*c + i*g`` and the carry stay
+    float32, targeting exactly the compounding path at ~none of the
+    throughput cost.
+
+    Semantics mirror flax's OptimizedLSTMCell exactly — gate order
+    (i, f, g, o), zero-init biases with NO extra forget offset, lecun
+    input kernels, per-gate orthogonal recurrent blocks — so a bf16-vs-
+    fp32 comparison measures precision alone.  NB the param tree differs
+    from the fp32 path's (input_proj/recurrent_proj vs the flax cell's
+    per-gate names): checkpoints do not interchange across dtypes.
+    """
+
+    hidden: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, carry: Carry, x: jnp.ndarray):
+        c, h = carry  # float32 by contract (lstm_initial_carry)
+        zx = nn.Dense(
+            4 * self.hidden, dtype=self.dtype, name="input_proj"
+        )(x)
+        zh = nn.Dense(
+            4 * self.hidden,
+            use_bias=False,
+            kernel_init=_blockwise_orthogonal(4),
+            dtype=self.dtype,
+            name="recurrent_proj",
+        )(h.astype(self.dtype))
+        # Gate math + state update in fp32.
+        z = (zx + zh).astype(jnp.float32)
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
+        h = nn.sigmoid(o) * jnp.tanh(c)
+        return (c, h), h.astype(self.dtype)
+
+
 class _Core(nn.Module):
     """Shared recurrent-or-dense core: LSTM cell when ``use_lstm`` else Dense."""
 
@@ -79,7 +140,17 @@ class _Core(nn.Module):
     def __call__(self, x: jnp.ndarray, carry: Carry, reset: jnp.ndarray):
         if self.use_lstm:
             carry = zeros_where_reset(carry, reset)
-            carry, y = nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype)(carry, x)
+            if self.dtype != jnp.float32:
+                # Reduced-precision mode routes through the fp32-carry cell
+                # (see MixedPrecisionLSTMCell); the fp32 default keeps the
+                # stock flax cell bit-for-bit.
+                carry, y = MixedPrecisionLSTMCell(self.hidden, dtype=self.dtype)(
+                    carry, x
+                )
+            else:
+                carry, y = nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype)(
+                    carry, x
+                )
             return y, carry
         y = nn.relu(
             nn.Dense(self.hidden, kernel_init=fan_in_uniform(), dtype=self.dtype)(x)

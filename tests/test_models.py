@@ -126,3 +126,67 @@ def test_jit_no_retrace():
     for _ in range(3):
         _, carry = step(params, obs, carry, jnp.zeros(B))
     assert step._cache_size() == n0 == 1
+
+
+# ---------------------------------------------------------------- bf16 core
+def test_bf16_net_keeps_fp32_carry():
+    """Reduced-precision nets route through MixedPrecisionLSTMCell: the
+    recurrent state must STAY float32 across steps (the round-3 dtype A/B
+    showed bf16 state accumulation costs ~3x walker learning)."""
+    net = ActorNet(action_dim=ACT, hidden=HID, use_lstm=True, dtype=jnp.bfloat16)
+    obs = jnp.zeros((B, OBS))
+    carry = net.initial_carry(B)
+    params = net.init(jax.random.PRNGKey(0), obs, carry, jnp.zeros(B))
+    for i in range(3):
+        action, carry = net.apply(
+            params, jnp.full((B, OBS), float(i)), carry, jnp.zeros(B)
+        )
+    for leaf in jax.tree_util.tree_leaves(carry):
+        assert leaf.dtype == jnp.float32, leaf.dtype
+    assert action.dtype == jnp.float32  # head output cast back
+
+
+def test_mixed_cell_tracks_fp32_reference_better_than_bf16_state():
+    """Property behind the design: with gate matmuls in bf16, keeping the
+    state update in fp32 must track the all-fp32 reference much closer
+    over a long unroll than also truncating the carry to bf16 each step
+    (the old behavior)."""
+    from r2d2dpg_tpu.models.actor_critic import MixedPrecisionLSTMCell
+
+    T, hidden = 120, HID
+    cell_ref = MixedPrecisionLSTMCell(hidden, dtype=jnp.float32)
+    cell_mix = MixedPrecisionLSTMCell(hidden, dtype=jnp.bfloat16)
+    x0 = jnp.zeros((B, hidden))
+    c0 = (jnp.zeros((B, hidden)), jnp.zeros((B, hidden)))
+    params = cell_ref.init(jax.random.PRNGKey(1), c0, x0)  # shared structure
+    xs = 0.5 * jax.random.normal(jax.random.PRNGKey(2), (T, B, hidden))
+
+    def run(cell, truncate_state):
+        carry = c0
+        hs = []
+        for t in range(T):
+            carry, h = cell.apply(params, carry, xs[t])
+            if truncate_state:
+                carry = jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.bfloat16).astype(jnp.float32), carry
+                )
+            hs.append(h.astype(jnp.float32))
+        return jnp.stack(hs)
+
+    ref = run(cell_ref, False)
+    mixed = run(cell_mix, False)
+    old_bf16 = run(cell_mix, True)
+    err_mixed = float(jnp.abs(mixed - ref).mean())
+    err_old = float(jnp.abs(old_bf16 - ref).mean())
+    assert err_mixed < err_old, (err_mixed, err_old)
+    # And the mixed error is small in absolute terms (h is in [-1, 1]).
+    assert err_mixed < 0.02, err_mixed
+
+
+def test_fp32_default_path_unchanged_by_mixed_cell():
+    """dtype=float32 must keep using the stock flax cell (param tree names
+    include OptimizedLSTMCell, not the mixed cell)."""
+    net, params, carry, obs = make_actor()
+    names = str(jax.tree_util.tree_structure(params))
+    assert "MixedPrecisionLSTMCell" not in names
+    assert "OptimizedLSTMCell" in names  # not merely renamed/rerouted
