@@ -1,0 +1,28 @@
+#!/bin/bash
+# Learning validation for the round-3 MixedPrecisionLSTMCell (bf16 gate
+# matmuls, fp32 state accumulation — models/actor_critic.py).
+#
+# The original dtype A/B (runs/walker_probe_bf16, OLD truncated-carry
+# cell) fell ~3x behind its fp32 control (145.5 vs 351.7 final eval on
+# the nstep3 recipe, docs/RESULTS.md).  This run repeats the EXACT same
+# arm — seed 3, 16 envs, 1:20 ratio, 85 min, --n-step 3, only
+# --compute-dtype bfloat16 — now routed through the fp32-carry cell, so
+# it answers: does keeping the cell state fp32 recover the fp32 learning
+# curve while keeping the MXU matmuls bf16?  Success bar: final 20-ep
+# eval within ~15% of the fp32 control's 351.7 (i.e. >= ~300) decides
+# the WALKER_R2D2.compute_dtype flip (bench headline ~31k steps/s/chip).
+#
+# Preemptible by the TPU campaign; superseded by the on-chip
+# walker30_bf16 (same cell, same question, better hardware).
+HERE="$(cd "$(dirname "$0")" && pwd)"
+cd "$HERE/.."
+mkdir -p runs
+exec >> runs/walker_mpbf16_probe.log 2>&1
+source "$HERE/lib_gate.sh" || exit 1
+
+run_evidence runs/walker_probe_mpbf16 runs/tpu/walker30_bf16/.done \
+  "walker_combo_probe\.sh" \
+  85 3 "--config walker_r2d2 --compute-dtype bfloat16" \
+  --config walker_r2d2 --compute-dtype bfloat16 \
+  --num-envs 16 --learner-steps 16 --batch-size 64 --min-replay 300 \
+  --n-step 3
