@@ -178,6 +178,17 @@ PENDULUM_R2D2 = ExperimentConfig(
 )
 
 # 3: the north-star metric config (walker-walk @ 30 min).
+#
+# n_step=3 / sigma_max=0.8 (were 5 / 0.4): the round-3 4-probe sweep
+# (docs/RESULTS.md "walker plateau") showed the long-standing 160-250
+# return band was an n-step-5 bootstrap-horizon cap, not a data wall —
+# n-step 3 alone reached 351.7 (20-ep eval, seed 3) vs the prior 198.9
+# best, still climbing at the probe's 330k-step cutoff; sigma 0.8 was
+# mildly ahead on its own (seed-4 combo corroboration pending — see
+# scripts/walker_combo_probe.sh).  BASELINE.json's literal n-step-5
+# spelling is preserved as
+# walker_r2d2_ns5 below (VERDICT r3 "next" #1: the recipe must live in
+# tracked state, not a gitignored flags file).
 WALKER_R2D2 = ExperimentConfig(
     name="walker_r2d2",
     env_factory=_dmc("walker", "walk", action_repeat=2),
@@ -185,7 +196,7 @@ WALKER_R2D2 = ExperimentConfig(
     agent=AgentConfig(
         burnin=20,
         unroll=20,
-        n_step=5,
+        n_step=3,
         gamma=0.99,
         tau=5e-3,
         actor_lr=1e-4,
@@ -199,9 +210,18 @@ WALKER_R2D2 = ExperimentConfig(
         capacity=100_000,
         prioritized=True,
         min_replay=2_000,
-        sigma_max=0.4,
+        sigma_max=0.8,
         ladder_alpha=7.0,
     ),
+)
+
+# BASELINE.json config #3 verbatim (n-step 5, sigma 0.4) — kept runnable so
+# the literal contract spelling stays one --config flag away.
+WALKER_R2D2_NS5 = dataclasses.replace(
+    WALKER_R2D2,
+    name="walker_r2d2_ns5",
+    agent=dataclasses.replace(WALKER_R2D2.agent, n_step=5),
+    trainer=dataclasses.replace(WALKER_R2D2.trainer, sigma_max=0.4),
 )
 
 # 4: long sequences (seq-len 80) at 256 actors.
@@ -290,6 +310,7 @@ CONFIGS: Dict[str, ExperimentConfig] = {
         PENDULUM_DDPG,
         PENDULUM_R2D2,
         WALKER_R2D2,
+        WALKER_R2D2_NS5,
         HUMANOID_R2D2,
         CHEETAH_PIXELS,
         PENDULUM_TINY,
