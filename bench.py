@@ -190,10 +190,17 @@ def _preempt_automation() -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         return  # documented CPU test mode: no tunnel client, nothing to settle
     # NB ``d=jax.devices`` catches the watcher's bare python probe client,
-    # which outlives a pkill of the watcher shell itself.
+    # which outlives a pkill of the watcher shell itself.  The round-5
+    # evidence-driver SHELLS are named too: killing only their python
+    # train leaves a run_evidence loop that relaunches a fresh train
+    # seconds later, into this bench's settle window (the drivers' own
+    # wait_on_box doesn't know bench.py); _rearm_automation restarts
+    # them after the last attempt.
     pat = (r"tpu_watcher[0-9]*\.sh|tpu_campaign[0-9]*\.sh"
            r"|r2d2dpg_tpu\.(train|eval)|phase_throughput|env_throughput"
-           r"|walker_probe|d=jax.devices")
+           r"|walker_probe|walker_combo_probe|walker_mpbf16_probe"
+           r"|cheetah_twin_probe|walker_ns3_long|arm_cpu_queue"
+           r"|d=jax.devices")
     probe = subprocess.run(["pgrep", "-f", pat], capture_output=True, text=True)
     if probe.returncode != 0:
         return  # nothing resident; connect immediately
@@ -209,27 +216,68 @@ def _preempt_automation() -> None:
     time.sleep(75)
 
 
+def _rearm_automation() -> None:
+    """Re-arm the measurement pipeline bench preempted (VERDICT r4 weak #1).
+
+    ``_preempt_automation`` kills the self-healing TPU watcher and the CPU
+    evidence drivers' train clients by name; bench is the ONLY process that
+    does so without restarting anything, and in round 4 that converted an
+    armed round-end into a dead one (watcher killed at ~05:17, nothing armed
+    when the round closed).  So after the last attempt — success or not —
+    relaunch the watcher (unless the campaign already wrote its terminal
+    marker, which makes a fresh watcher exit immediately) and the idempotent
+    CPU evidence queue.  Detached sessions: bench's own exit must not reap
+    them.
+    """
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return  # documented CPU test mode: nothing was preempted
+    def spawn(script: str) -> None:
+        path = os.path.join(HERE, "scripts", script)
+        if not os.path.exists(path):
+            return
+        with open(os.path.join(HERE, "runs", "watcher_nohup.log"), "a") as log:
+            subprocess.Popen(
+                ["bash", path], cwd=HERE, stdout=log, stderr=log,
+                stdin=subprocess.DEVNULL, start_new_session=True,
+            )
+    os.makedirs(os.path.join(HERE, "runs"), exist_ok=True)
+    watcher_alive = subprocess.run(
+        ["pgrep", "-f", r"tpu_watcher[0-9]*\.sh"], capture_output=True
+    ).returncode == 0
+    campaign_done = os.path.exists(
+        os.path.join(HERE, "runs", "tpu", "campaign3.complete")
+    )
+    if not watcher_alive and not campaign_done:
+        spawn("tpu_watcher3.sh")
+        print("bench: re-armed tpu_watcher3", file=sys.stderr)
+    spawn("arm_cpu_queue.sh")
+    print("bench: re-armed CPU evidence queue", file=sys.stderr)
+
+
 def main() -> None:
     # None = let the worker follow the flagship config's compute dtype.
     dtype = sys.argv[1] if len(sys.argv) > 1 else None
     _preempt_automation()
-    last_err = "no attempt ran"
-    for i in range(TPU_TRIES):
-        if i:
-            time.sleep(SETTLE_S[min(i - 1, len(SETTLE_S) - 1)])
-        rec, reason = _run_child(dtype, backend="tpu")
+    try:
+        last_err = "no attempt ran"
+        for i in range(TPU_TRIES):
+            if i:
+                time.sleep(SETTLE_S[min(i - 1, len(SETTLE_S) - 1)])
+            rec, reason = _run_child(dtype, backend="tpu")
+            if rec is not None:
+                print(json.dumps(rec))
+                return
+            last_err = f"tpu attempt {i + 1}/{TPU_TRIES}: {reason}"
+            if "not tpu" in reason:
+                break  # CPU-resolved backend is deterministic; don't burn settles
+        rec, _ = _run_child(dtype, backend="cpu")
         if rec is not None:
             print(json.dumps(rec))
             return
-        last_err = f"tpu attempt {i + 1}/{TPU_TRIES}: {reason}"
-        if "not tpu" in reason:
-            break  # CPU-resolved backend is deterministic; don't burn settles
-    rec, _ = _run_child(dtype, backend="cpu")
-    if rec is not None:
-        print(json.dumps(rec))
-        return
-    _emit(0.0, 0.0, "none", error=last_err + "; cpu fallback also failed")
-    sys.exit(0)  # the JSON line IS the contract; don't fail the driver's parse
+        _emit(0.0, 0.0, "none", error=last_err + "; cpu fallback also failed")
+        sys.exit(0)  # the JSON line IS the contract; don't fail the driver's parse
+    finally:
+        _rearm_automation()
 
 
 def worker() -> None:

@@ -8,7 +8,9 @@ constants in ``main.py``; here each BASELINE config is a named experiment
 |---|-------------------|-------------------------------------------------------------|
 | 1 | pendulum_ddpg     | Pendulum-v1, 1 actor, feedforward DDPG, uniform replay      |
 | 2 | pendulum_r2d2     | Pendulum-v1, 4 actors, LSTM + burn-in, prioritized replay   |
-| 3 | walker_r2d2       | DM-Control Walker-walk, 64 actors, seq-len 40, n-step 5     |
+| 3 | walker_r2d2       | DM-Control Walker-walk, 64 actors, seq-len 40, n-step 3 /   |
+|   |                   | sigma 0.8 (evidence-flipped defaults; the BASELINE-verbatim |
+|   |                   | n-step-5 / sigma-0.4 spelling is `walker_r2d2_ns5`)         |
 | 4 | humanoid_r2d2     | DM-Control Humanoid-run, 256 actors, seq-len 80, soft-update|
 | 5 | cheetah_pixels    | DM-Control Cheetah-run from pixels, CNN+LSTM, 256 actors    |
 """

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Idempotently (re-)arm the round-5 CPU evidence chain (VERDICT r4 next
+# #2).  Each driver is launched only if an instance isn't already
+# resident — two instances of the same run_evidence driver could race
+# each other's attempt loops on the single-core box.  Safe to call any
+# time: drivers exit immediately when their .done artifact exists, and
+# gate on the box (live trains / TPU campaign) before touching anything.
+HERE="$(cd "$(dirname "$0")" && pwd)"
+cd "$HERE/.."
+for s in walker_combo_probe walker_mpbf16_probe cheetah_twin_probe walker_ns3_long; do
+  pgrep -f "scripts/$s\.sh" > /dev/null \
+    || setsid nohup bash "$HERE/$s.sh" > /dev/null 2>&1 < /dev/null &
+done

@@ -1,0 +1,33 @@
+#!/bin/bash
+# Config-#5 twin-critic de-confound (VERDICT r4 next #2: "cheetah
+# twin-critic-only arm (round-2 regime + --twin-critic 1)").
+#
+# Round 2 collapsed at the ORIGINAL regime (8 envs, 4 updates/phase,
+# batch 8, actor-lr 1e-4): eval 4.1 -> 1.5 by 94 min / 67k steps.
+# Round 3's mitigation changed TWO knobs at once (batch 16x2 AND
+# actor-lr 5e-5) and the collapse disappeared — so which knob fixed it
+# is confounded, and twin critic (the stronger, opt-in fix per
+# configs/__init__.py) has never been tested alone.  This arm replays
+# the round-2 collapse regime exactly (actor-lr pinned back to 1e-4,
+# overriding the round-3 config default of 5e-5) with ONLY
+# --twin-critic 1 changed.  Success bar: eval monotone past the round-2
+# collapse point (~67k env steps / ~94 min) => clipped double-Q alone
+# defeats the overestimation collapse; collapse anyway => the actor-lr
+# knob was the load-bearing fix.
+#
+# Queued behind the walker mpbf16 probe (single-core box); preemptible
+# by the TPU campaign.  Not superseded by the campaign's cheetah step:
+# that run uses the mitigated defaults + drop-in flags, so it cannot
+# answer the twin-critic-only question.
+HERE="$(cd "$(dirname "$0")" && pwd)"
+cd "$HERE/.."
+mkdir -p runs
+exec >> runs/cheetah_twin_probe.log 2>&1
+source "$HERE/lib_gate.sh" || exit 1
+
+run_evidence runs/cheetah_twin_probe "" \
+  "walker_combo_probe\.sh|walker_mpbf16_probe\.sh" \
+  115 1 "--config cheetah_pixels --twin-critic 1" \
+  --config cheetah_pixels \
+  --num-envs 8 --learner-steps 4 --batch-size 8 --min-replay 200 \
+  --actor-lr 1e-4 --twin-critic 1

@@ -20,23 +20,32 @@
 # them here on their next at-rest edit).
 wait_on_box() {
   local extra="${1:-}"
+  # bench[0-9]*\.py: the driver's round-end bench preempts this driver's
+  # python train by name; without this clause the attempt loop would
+  # relaunch a fresh train straight into bench's settle window and
+  # contend with the TPU measurement on the single core.
   while pgrep -f "r2d2dpg_tpu\.(train|eval)" > /dev/null \
      || pgrep -f "tpu_campaign[0-9]*\.sh" > /dev/null \
+     || pgrep -f "bench[0-9]*\.py" > /dev/null \
      || { [ -n "$extra" ] && pgrep -f "$extra" > /dev/null; }; do
     sleep 60
   done
 }
 
 # Shared CPU evidence-run driver: budgeted train + final 20-ep eval +
-# .done stamp, with up to 3 attempts.  A completed training run is never
+# .done stamp, with up to 3 attempts.  A train run that spent its FULL
+# wall-clock budget (stamped $dir/.train_complete on rc=0) is never
 # discarded over a transient eval failure: the train step re-runs only
-# when no usable checkpoint exists.
+# when no completed run exists.  A PREEMPTED train (killed by the TPU
+# campaign's kill-list mid-budget) leaves a checkpoint but no marker and
+# is restarted from scratch — evaluating a partial train would stamp
+# .done on evidence that answers a different (shorter-budget) question.
 #   run_evidence <dir> <supersede-artifact|""> <wait-extra-pattern> \
 #                <minutes> <seed> "<eval flags>" <train args...>
 run_evidence() {
   local dir=$1 supersede=$2 waitpat=$3 minutes=$4 seed=$5 evalflags=$6
   shift 6
-  local attempt
+  local attempt rc
   for attempt in 1 2 3; do
     if [ -f "$dir/.done" ]; then
       echo "$dir: already done; exiting $(date)"
@@ -47,7 +56,7 @@ run_evidence() {
       return 0
     fi
     wait_on_box "$waitpat"
-    if ! { [ -d "$dir/ckpt" ] && [ -n "$(ls "$dir/ckpt" 2>/dev/null)" ]; }; then
+    if ! [ -f "$dir/.train_complete" ]; then
       echo "=== $dir attempt $attempt train start ($*) $(date) ==="
       rm -rf "$dir"
       mkdir -p "$dir"
@@ -57,11 +66,14 @@ run_evidence() {
         --log-every 10 --eval-every 150 --eval-envs 5 \
         --logdir "$dir" --checkpoint-dir "$dir/ckpt" --checkpoint-every 150 \
         > "$dir/stdout.log" 2>&1
-      echo "=== $dir attempt $attempt train done rc=$? $(date) ==="
+      rc=$?
+      echo "=== $dir attempt $attempt train done rc=$rc $(date) ==="
+      [ $rc -eq 0 ] && touch "$dir/.train_complete"
     else
-      echo "$dir: usable checkpoint exists; retrying eval only $(date)"
+      echo "$dir: completed train exists; retrying eval only $(date)"
     fi
-    if [ -d "$dir/ckpt" ] && [ -n "$(ls "$dir/ckpt" 2>/dev/null)" ]; then
+    if [ -f "$dir/.train_complete" ] \
+       && [ -d "$dir/ckpt" ] && [ -n "$(ls "$dir/ckpt" 2>/dev/null)" ]; then
       wait_on_box "$waitpat"
       timeout --kill-after=30 --signal=TERM 1800 \
         env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \

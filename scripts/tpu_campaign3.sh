@@ -72,8 +72,11 @@ sleep 60
 # skips probes whose artifacts already landed; the cheetah/bf16 drivers
 # survive preemption on their own retry loops).
 resume_cpu_queue() {
-  pgrep -f "walker_probe\.sh" > /dev/null \
-    || setsid nohup bash "$HERE/walker_probe.sh" > /dev/null 2>&1 < /dev/null &
+  # Round-5 evidence chain (combo/mpbf16/cheetah-twin/ns3-long).  NOT the
+  # round-3 walker_probe.sh sweep: its artifacts did not survive the round
+  # boundary (runs/ is ephemeral), so relaunching it would re-run hours of
+  # already-answered probes on the single core.
+  bash "$HERE/arm_cpu_queue.sh"
 }
 
 MAX_WEDGES=8
@@ -136,6 +139,51 @@ run_bench() {
   fi
   sleep 60
 }
+
+# --------------------------------------------------------------- step 0
+# North-star insurance (VERDICT r4 next #1): the campaign has twice died
+# inside the 1800s phase_throughput step when the tunnel window closed,
+# leaving four rounds with ZERO on-chip walker training artifacts.  So
+# before spending a (possibly brief) window on flag tuning, bank a
+# bounded walker SLICE — 10 min of config-default training + a short
+# eval — the first on-chip return@wall-clock row, whatever the number.
+# Skipped once any on-chip walker artifact exists.
+if [ -f runs/tpu/walker30/.done ] || [ -f runs/tpu/walker30_slice/.done ]; then
+  echo "--- walker30_slice: on-chip walker artifact already banked, skipping $(date) ---"
+else
+  echo "--- walker30_slice: 10-min north-star insurance $(date) ---"
+  if [ -d runs/tpu/walker30_slice ]; then
+    mv runs/tpu/walker30_slice "runs/tpu/walker30_slice.partial.$(date +%s)"
+  fi
+  mkdir -p runs/tpu/walker30_slice
+  # Sequential-48 (the documented no-measurement fallback): the overlap
+  # bet is exactly what phase_throughput has not yet proven.
+  timeout --kill-after=60 --signal=TERM 1500 python -m r2d2dpg_tpu.train --config walker_r2d2 \
+    --num-envs 64 --batch-size 64 --overlap-learner 0 --learner-steps 48 \
+    --minutes 10 --log-every 10 --eval-every 100 --eval-envs 5 \
+    --logdir runs/tpu/walker30_slice --checkpoint-dir runs/tpu/walker30_slice/ckpt \
+    --checkpoint-every -1 --checkpoint-light | tail -30
+  rc=$?
+  bail_if_wedged $rc walker30_slice
+  if [ $rc -eq 0 ] && train_backend_ok runs/tpu/walker30_slice; then
+    touch runs/tpu/walker30_slice/.done
+  else
+    echo "walker30_slice FAILED (rc=$rc, backend=$(cat runs/tpu/walker30_slice/backend.txt 2>/dev/null || echo none)); preserving partial"
+    mv "runs/tpu/walker30_slice" "runs/tpu/walker30_slice.failed.$(date +%s)"
+  fi
+  sleep 60
+fi
+if [ -f runs/tpu/walker30_slice/.done ] && [ ! -s runs/tpu/walker30_slice_eval.json ] \
+   && [ -d runs/tpu/walker30_slice/ckpt ] && [ -n "$(ls runs/tpu/walker30_slice/ckpt 2>/dev/null)" ]; then
+  echo "--- walker30_slice deterministic eval $(date) ---"
+  timeout --kill-after=30 --signal=TERM 600 python -m r2d2dpg_tpu.eval --config walker_r2d2 \
+    --checkpoint-dir runs/tpu/walker30_slice/ckpt --episodes 5 --rounds 2 \
+    | tee runs/tpu/walker30_slice_eval.jsonl
+  rc=$?
+  bail_if_wedged $rc walker30_slice_eval
+  [ $rc -eq 0 ] && tail -1 runs/tpu/walker30_slice_eval.jsonl > runs/tpu/walker30_slice_eval.json
+  sleep 60
+fi
 
 # --------------------------------------------------------------- step 1
 # Overlap proof at walker shapes (64 envs / stride 20 / 48 learner steps),
@@ -274,6 +322,17 @@ run_walker walker30
 run_walker walker30_bf16 --compute-dtype bfloat16
 
 # --------------------------------------------------------------- step 4
+# Mixed-precision cell throughput (VERDICT r4 next #4): the 31,282
+# steps/s bf16 headline was measured on the OLD truncated-carry cell;
+# the round-4 MixedPrecisionLSTMCell adds fp32 elementwise state math +
+# casts and has no TPU number.  Two rows, same harness as the driver's
+# headline bench (bench.py worker invoked directly — its outer main()
+# preempts watcher/campaign automation, i.e. this script's own parent).
+run_bench runs/tpu/bench_cell_fp32.json bench_cell_fp32 600 \
+  env R2D2DPG_BENCH_WORKER=1 python bench.py float32
+run_bench runs/tpu/bench_cell_bf16.json bench_cell_bf16 600 \
+  env R2D2DPG_BENCH_WORKER=1 python bench.py bfloat16
+
 run_bench runs/tpu/env_pendulum.json env_throughput 600 \
   python benchmarks/env_throughput.py 1024 200 pendulum
 
@@ -328,6 +387,7 @@ ALL_DONE=1
 for a in runs/tpu/phase_throughput.json runs/tpu/walker30/.done \
          runs/tpu/walker30_eval.json runs/tpu/walker30_bf16/.done \
          runs/tpu/walker30_bf16_eval.json runs/tpu/env_pendulum.json \
+         runs/tpu/bench_cell_fp32.json runs/tpu/bench_cell_bf16.json \
          runs/tpu/cheetah_pixels/.done runs/tpu/humanoid/.done; do
   [ -e "$a" ] || { echo "missing artifact: $a"; ALL_DONE=0; }
 done

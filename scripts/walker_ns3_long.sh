@@ -1,0 +1,26 @@
+#!/bin/bash
+# Locate the n-step-3 plateau (VERDICT r4 next #2: "resume of the seed-3
+# n-step-3 run to 600k-1M steps").  The round-3 probe run's checkpoint
+# (runs/walker_probe_nstep3) did not survive the round boundary (runs/ is
+# ephemeral), so this is a FRESH seed-3 run of the same arm — n-step 3,
+# sigma_max 0.4, the exact recipe that reached 351.7 @ 330k and was still
+# climbing at its 95-min cutoff — with ~2.3x the wall-clock so the curve
+# reaches the 600k-800k-step region where the new plateau (if any) lives.
+# Doubles as the sigma-0.4 comparison arm against the seed-4 combo probe
+# (sigma 0.8), informing whether WALKER_R2D2.sigma_max stays 0.8.
+#
+# Last in the CPU queue; preemptible by the TPU campaign; superseded by
+# an on-chip walker30 artifact (the north star answers the walker
+# question at better hardware).
+HERE="$(cd "$(dirname "$0")" && pwd)"
+cd "$HERE/.."
+mkdir -p runs
+exec >> runs/walker_ns3_long.log 2>&1
+source "$HERE/lib_gate.sh" || exit 1
+
+run_evidence runs/walker_ns3_long runs/tpu/walker30/.done \
+  "walker_combo_probe\.sh|walker_mpbf16_probe\.sh|cheetah_twin_probe\.sh" \
+  220 3 "--config walker_r2d2" \
+  --config walker_r2d2 \
+  --num-envs 16 --learner-steps 16 --batch-size 64 --min-replay 300 \
+  --n-step 3 --sigma-max 0.4
