@@ -350,13 +350,20 @@ def worker() -> None:
 
     CHUNK = 50
 
-    @jax.jit
-    def run_chunk(train, arena_state, key):
+    # Donate (train, arena) like the production jits do (trainer.py /
+    # parallel/hybrid.py donate_argnums=(0,)): without donation XLA must
+    # materialize fresh output buffers for the threaded-through arena
+    # (hundreds of MB at capacity 100k) on every chunk boundary — a copy
+    # the real learner loop never pays, which understates steps/s on the
+    # HBM-bandwidth-limited chip.
+    def _run_chunk(train, arena_state, key):
         keys = jax.random.split(key, CHUNK)
         (train, arena_state), out = jax.lax.scan(
             one_step, (train, arena_state), keys
         )
         return train, arena_state, out.mean()
+
+    run_chunk = jax.jit(_run_chunk, donate_argnums=(0, 1))
 
     # Warm-up / compile.
     train, arena_state, _ = run_chunk(train, arena_state, ks[5])
