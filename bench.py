@@ -297,7 +297,7 @@ def worker() -> None:
         with open(hb, "w") as f:
             f.write(backend + "\n")
 
-    from r2d2dpg_tpu.agents import AgentConfig, R2D2DPG
+    from r2d2dpg_tpu.agents import R2D2DPG
     from r2d2dpg_tpu.configs import WALKER_R2D2
     from r2d2dpg_tpu.models import ActorNet, CriticNet
     from r2d2dpg_tpu.replay import ReplayArena, SequenceBatch
@@ -309,9 +309,12 @@ def worker() -> None:
         sys.argv[1] if len(sys.argv) > 1 else WALKER_R2D2.compute_dtype
     )
 
-    # Config-#3 (walker_r2d2) learner shapes.
+    # Config-#3 (walker_r2d2) learner shapes; the agent recipe (burn-in,
+    # unroll, n-step, lrs) comes from the flagship config itself so a
+    # recorded default flip (e.g. round 3's n-step 5 -> 3) moves the
+    # headline measurement with it, same as compute_dtype above.
     batch, obs_dim, act_dim, hidden = 64, 24, 6, 256
-    cfg = AgentConfig(burnin=20, unroll=20, n_step=5)
+    cfg = WALKER_R2D2.agent
     seq_len = cfg.seq_len
     capacity = 100_000
 

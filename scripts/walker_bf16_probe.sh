@@ -4,10 +4,10 @@
 # Mirrors runs/walker_probe_nstep3 — the WINNING plateau probe (final
 # 20-ep eval 351.7 @ ~330k steps; seed 3, 16 envs, 1:20 ratio, 85 min,
 # --n-step 3) — with only --compute-dtype bfloat16 changed, so the two
-# curves are a controlled dtype A/B on the nstep3 recipe (NOT the full
-# north-star flag set: the on-chip run adds --sigma-max 0.8, which has no
-# fp32 control arm at this regime — the dtype call rests on the
-# controlled pair).  If the bf16 curve matches fp32 (as it did on
+# curves are a controlled dtype A/B on the nstep3 recipe — which, since
+# the round-5 sigma revert (combo probe: sigma 0.8 erases the n-step-3
+# gain), IS the recorded north-star recipe (n-step 3 + sigma 0.4, now
+# the walker_r2d2 config defaults).  If the bf16 curve matches fp32 (as it did on
 # pendulum, docs/RESULTS.md), WALKER_R2D2's compute_dtype default flips
 # to bfloat16 and bench.py's headline follows (~31k steps/s/chip
 # measured round 2).

@@ -6,8 +6,10 @@
 # sigma_max 0.4, the exact recipe that reached 351.7 @ 330k and was still
 # climbing at its 95-min cutoff — with ~2.3x the wall-clock so the curve
 # reaches the 600k-800k-step region where the new plateau (if any) lives.
-# Doubles as the sigma-0.4 comparison arm against the seed-4 combo probe
-# (sigma 0.8), informing whether WALKER_R2D2.sigma_max stays 0.8.
+# (The sigma question is settled: the seed-4 combo probe measured
+# n-step 3 + sigma 0.8 far behind this arm at equal steps, and round 5
+# reverted WALKER_R2D2.sigma_max to 0.4 — this run's explicit flags now
+# equal the config defaults.)
 #
 # Last in the CPU queue; preemptible by the TPU campaign; superseded by
 # an on-chip walker30 artifact (the north star answers the walker
