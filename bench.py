@@ -241,8 +241,11 @@ def _rearm_automation() -> None:
                 stdin=subprocess.DEVNULL, start_new_session=True,
             )
     os.makedirs(os.path.join(HERE, "runs"), exist_ok=True)
+    # Anchored (see scripts/lib_gate.sh): a substring match also hits
+    # resident shells that merely mention the watcher's name, and a
+    # false "alive" here means a dead round-end with nothing armed.
     watcher_alive = subprocess.run(
-        ["pgrep", "-f", r"tpu_watcher[0-9]*\.sh"], capture_output=True
+        ["pgrep", "-f", r"^[^ ]*bash [^ ]*tpu_watcher[0-9]*\.sh"], capture_output=True
     ).returncode == 0
     campaign_done = os.path.exists(
         os.path.join(HERE, "runs", "tpu", "campaign3.complete")

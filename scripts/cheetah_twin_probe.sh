@@ -26,7 +26,7 @@ exec >> runs/cheetah_twin_probe.log 2>&1
 source "$HERE/lib_gate.sh" || exit 1
 
 run_evidence runs/cheetah_twin_probe "" \
-  "walker_combo_probe\.sh|walker_mpbf16_probe\.sh" \
+  "^[^ ]*bash [^ ]*(walker_combo_probe|walker_mpbf16_probe)\.sh" \
   115 1 "--config cheetah_pixels --twin-critic 1" \
   --config cheetah_pixels \
   --num-envs 8 --learner-steps 4 --batch-size 8 --min-replay 200 \

@@ -18,15 +18,28 @@
 # rather than skip (walker_probe/cheetah_mitigation carry private copies
 # only because they were live processes when this helper landed — migrate
 # them here on their next at-rest edit).
+# Liveness patterns are ANCHORED to the real process shapes ("python -m
+# r2d2dpg_tpu.train ...", "bash .../script.sh"): an unanchored substring
+# match also hits unrelated resident shells whose COMMAND LINE merely
+# mentions these names (interactive wrappers, editors, ps/grep pipelines),
+# and a wait loop blocked on such a process never wakes up — this
+# deadlocked the round-5 evidence queue for 10 minutes behind a stale
+# interactive shell.  Kill-lists (campaign VICTIMS, bench preempt) stay
+# deliberately unanchored: a rare false-positive kill is recoverable,
+# a false-positive WAIT is forever.
+TRAIN_PAT='^[^ ]*python[0-9.]* -m r2d2dpg_tpu\.(train|eval)'
+CAMPAIGN_PAT='^[^ ]*bash [^ ]*tpu_campaign[0-9]*\.sh'
+BENCH_PAT='^[^ ]*python[0-9.]* [^ ]*bench\.py'
+
+# bench: the driver's round-end bench preempts this driver's python train
+# by name; without that clause the attempt loop would relaunch a fresh
+# train straight into bench's settle window and contend with the TPU
+# measurement on the single core.
 wait_on_box() {
   local extra="${1:-}"
-  # bench[0-9]*\.py: the driver's round-end bench preempts this driver's
-  # python train by name; without this clause the attempt loop would
-  # relaunch a fresh train straight into bench's settle window and
-  # contend with the TPU measurement on the single core.
-  while pgrep -f "r2d2dpg_tpu\.(train|eval)" > /dev/null \
-     || pgrep -f "tpu_campaign[0-9]*\.sh" > /dev/null \
-     || pgrep -f "bench[0-9]*\.py" > /dev/null \
+  while pgrep -f "$TRAIN_PAT" > /dev/null \
+     || pgrep -f "$CAMPAIGN_PAT" > /dev/null \
+     || pgrep -f "$BENCH_PAT" > /dev/null \
      || { [ -n "$extra" ] && pgrep -f "$extra" > /dev/null; }; do
     sleep 60
   done
@@ -89,15 +102,15 @@ run_evidence() {
 
 gate_on_box() {
   local artifact="$1" extra="${2:-}"
-  while pgrep -f "r2d2dpg_tpu.train" > /dev/null \
+  while pgrep -f "$TRAIN_PAT" > /dev/null \
      || { [ -n "$extra" ] && pgrep -f "$extra" > /dev/null; }; do
-    if pgrep -f "tpu_campaign[0-9]*\.sh" > /dev/null; then
+    if pgrep -f "$CAMPAIGN_PAT" > /dev/null; then
       echo "TPU campaign owns the box; skipping $(date)"
       return 1
     fi
     sleep 60
   done
-  if pgrep -f "tpu_campaign[0-9]*\.sh" > /dev/null \
+  if pgrep -f "$CAMPAIGN_PAT" > /dev/null \
      || { [ -n "$artifact" ] && [ -f "$artifact" ]; }; then
     echo "TPU campaign owns/owned the box; skipping $(date)"
     return 1

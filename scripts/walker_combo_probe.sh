@@ -14,7 +14,7 @@ exec >> runs/walker_combo_probe.log 2>&1
 source "$HERE/lib_gate.sh" || exit 1
 
 run_evidence runs/walker_probe_combo runs/tpu/walker30/.done \
-  "walker_probe\.sh|cheetah_mitigation\.sh|walker_bf16_probe\.sh" \
+  "^[^ ]*bash [^ ]*(walker_probe|cheetah_mitigation|walker_bf16_probe)\.sh" \
   95 4 "--config walker_r2d2" \
   --config walker_r2d2 \
   --num-envs 16 --learner-steps 16 --batch-size 64 --min-replay 300 \

@@ -21,7 +21,7 @@ exec >> runs/walker_mpbf16_probe.log 2>&1
 source "$HERE/lib_gate.sh" || exit 1
 
 run_evidence runs/walker_probe_mpbf16 runs/tpu/walker30_bf16/.done \
-  "walker_combo_probe\.sh" \
+  "^[^ ]*bash [^ ]*walker_combo_probe\.sh" \
   85 3 "--config walker_r2d2 --compute-dtype bfloat16" \
   --config walker_r2d2 --compute-dtype bfloat16 \
   --num-envs 16 --learner-steps 16 --batch-size 64 --min-replay 300 \
