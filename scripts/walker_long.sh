@@ -13,7 +13,7 @@ source "$HERE/lib_gate.sh" || exit 1
 # Gate on the campaign's COMPLETION marker, not metrics.csv (which appears
 # seconds into a run and would suppress this fallback forever after a
 # killed campaign — ADVICE r2 #2).
-gate_on_box runs/tpu/walker30/.done "humanoid_retry.sh" || exit 0
+gate_on_box runs/tpu/walker30/.done "^[^ ]*bash [^ ]*humanoid_retry\.sh" || exit 0
 
 echo "=== walker_long start $(date) ==="
 mkdir -p runs/walker_cpu_long
