@@ -113,6 +113,13 @@ class MixedPrecisionLSTMCell(nn.Module):
     of the unroll scan).  A bf16-vs-fp32 comparison therefore measures
     precision alone, and a checkpoint written under either dtype restores
     under the other.
+
+    Measured outcome (round-5 controlled A/B, docs/RESULTS.md
+    "Mixed-precision cell learning probe"): the fp32 carry did NOT
+    recover walker learning parity — final 146.6 vs the fp32 control's
+    351.7, within noise of the old truncated-carry cell's 145.5 — so the
+    binding precision path is the bf16 gate math itself, and
+    ``compute_dtype`` defaults stay float32.
     """
 
     hidden: int
