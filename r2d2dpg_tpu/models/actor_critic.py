@@ -115,11 +115,15 @@ class MixedPrecisionLSTMCell(nn.Module):
     under the other.
 
     Measured outcome (round-5 controlled A/B, docs/RESULTS.md
-    "Mixed-precision cell learning probe"): the fp32 carry did NOT
-    recover walker learning parity — final 146.6 vs the fp32 control's
-    351.7, within noise of the old truncated-carry cell's 145.5 — so the
-    binding precision path is the bf16 gate math itself, and
-    ``compute_dtype`` defaults stay float32.
+    "Mixed-precision cell learning probe", taken on the fp32-CARRY
+    revision of this cell BEFORE the fp32-accumulator dots below): the
+    fp32 carry alone did NOT recover walker learning parity — final
+    146.6 vs the fp32 control's 351.7, within noise of the old
+    truncated-carry cell's 145.5 — implicating the bf16-truncated matmul
+    accumulator, which the ``preferred_element_type`` dots below remove
+    (unrolled |h| error vs fp32 drops ~16x).  The accumulator variant's
+    learning measurement is `scripts/walker_bf16acc_probe.sh` (pending);
+    ``compute_dtype`` defaults stay float32 until it passes.
     """
 
     hidden: int
@@ -141,10 +145,24 @@ class MixedPrecisionLSTMCell(nn.Module):
             )()
             wh.append(k)
             bh.append(b)
-        zx = x.astype(self.dtype) @ jnp.concatenate(wi, axis=1).astype(self.dtype)
-        zh = h.astype(self.dtype) @ jnp.concatenate(wh, axis=1).astype(self.dtype)
+        # Operands stream in ``dtype`` (the HBM/MXU win) but the dot
+        # ACCUMULATES in fp32 via preferred_element_type — free on TPU,
+        # whose MXU natively accumulates bf16 products into fp32; without
+        # it XLA truncates the accumulator to bf16 at every step of the
+        # recurrence, which the round-5 A/B implicates as the remaining
+        # compounding-error path (docs/RESULTS.md "Mixed-precision cell").
+        zx = jnp.matmul(
+            x.astype(self.dtype),
+            jnp.concatenate(wi, axis=1).astype(self.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        zh = jnp.matmul(
+            h.astype(self.dtype),
+            jnp.concatenate(wh, axis=1).astype(self.dtype),
+            preferred_element_type=jnp.float32,
+        )
         # Gate math + state update in fp32 (bias join included).
-        z = (zx + zh).astype(jnp.float32) + jnp.concatenate(bh, axis=0)
+        z = zx + zh + jnp.concatenate(bh, axis=0)
         i, f, g, o = jnp.split(z, 4, axis=-1)
         c = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
         h = nn.sigmoid(o) * jnp.tanh(c)

@@ -10,7 +10,7 @@ cd "$HERE/.."
 # Anchored pattern (see lib_gate.sh): an unanchored match also hits
 # resident shells that merely MENTION the script name, which would skip
 # the launch forever.
-for s in walker_combo_probe walker_mpbf16_probe cheetah_twin_probe walker_ns3_long; do
+for s in walker_combo_probe walker_mpbf16_probe cheetah_twin_probe walker_bf16acc_probe walker_ns3_long; do
   pgrep -f "^[^ ]*bash [^ ]*scripts/$s\.sh" > /dev/null \
     || setsid nohup bash "$HERE/$s.sh" > /dev/null 2>&1 < /dev/null &
 done

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Third walker dtype A/B arm: MixedPrecisionLSTMCell with fp32 matmul
+# ACCUMULATORS (preferred_element_type=float32 on the two gate dots —
+# models/actor_critic.py round-5 edit).
+#
+# Chain of evidence: the round-3 A/B (old truncated-carry cell) lost 3x
+# to fp32 (145.5 vs 351.7); the round-5 A/B on the fp32-carry cell
+# landed within noise of the old cell (146.6) — so the carry was NOT the
+# binding path, implicating the bf16-truncated matmul accumulator in the
+# recurrence.  With fp32 accumulation the cell's unrolled error vs fp32
+# drops ~16x (3.0e-4 mean |h| error over 120 steps vs the carry-only
+# cell).  This run repeats the EXACT same arm a third time (seed 3,
+# 16 envs, 1:20, --n-step 3, 85 min, only --compute-dtype bfloat16) to
+# ask whether fp32 accumulation recovers the fp32 learning curve.
+# Success bar unchanged: final 20-ep eval >= ~300 (vs fp32's 351.7)
+# flips WALKER_R2D2.compute_dtype; the TPU throughput row
+# (runs/tpu/bench_cell_bf16.json) is the other half of that decision —
+# preferred_element_type costs nothing on the MXU (it natively
+# accumulates bf16 products in fp32) but must be confirmed on-chip.
+#
+# Queued behind the cheetah twin-critic probe; preemptible by the TPU
+# campaign; superseded by the on-chip walker30_bf16 (same cell, same
+# question, better hardware).
+HERE="$(cd "$(dirname "$0")" && pwd)"
+cd "$HERE/.."
+mkdir -p runs
+exec >> runs/walker_bf16acc_probe.log 2>&1
+source "$HERE/lib_gate.sh" || exit 1
+
+run_evidence runs/walker_probe_bf16acc runs/tpu/walker30_bf16/.done \
+  "^[^ ]*bash [^ ]*(walker_combo_probe|walker_mpbf16_probe|cheetah_twin_probe)\.sh" \
+  85 3 "--config walker_r2d2 --compute-dtype bfloat16" \
+  --config walker_r2d2 --compute-dtype bfloat16 \
+  --num-envs 16 --learner-steps 16 --batch-size 64 --min-replay 300 \
+  --n-step 3

@@ -21,7 +21,7 @@ exec >> runs/walker_ns3_long.log 2>&1
 source "$HERE/lib_gate.sh" || exit 1
 
 run_evidence runs/walker_ns3_long runs/tpu/walker30/.done \
-  "^[^ ]*bash [^ ]*(walker_combo_probe|walker_mpbf16_probe|cheetah_twin_probe)\.sh" \
+  "^[^ ]*bash [^ ]*(walker_combo_probe|walker_mpbf16_probe|cheetah_twin_probe|walker_bf16acc_probe)\.sh" \
   220 3 "--config walker_r2d2" \
   --config walker_r2d2 \
   --num-envs 16 --learner-steps 16 --batch-size 64 --min-replay 300 \
