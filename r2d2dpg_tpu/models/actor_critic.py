@@ -122,8 +122,11 @@ class MixedPrecisionLSTMCell(nn.Module):
     truncated-carry cell's 145.5 — implicating the bf16-truncated matmul
     accumulator, which the ``preferred_element_type`` dots below remove
     (unrolled |h| error vs fp32 drops ~16x).  The accumulator variant's
-    learning measurement is `scripts/walker_bf16acc_probe.sh` (pending);
-    ``compute_dtype`` defaults stay float32 until it passes.
+    round-5 measurement (RESULTS.md "fp32-accumulator cell probe"):
+    final 274.4 vs fp32's 351.7 — a ~60% recovery over the carry-only
+    cells (145.5/146.6) but still short of parity, so ``compute_dtype``
+    defaults stay float32; the residual loss is bf16 rounding of the
+    streamed operands themselves.
     """
 
     hidden: int
