@@ -1,5 +1,23 @@
-"""``python -m r2d2dpg_tpu`` == ``python -m r2d2dpg_tpu.train``."""
+"""``python -m r2d2dpg_tpu <cmd> ...`` — subcommand dispatch.
 
-from r2d2dpg_tpu.train import main
+``train`` (the default, so the historical ``python -m r2d2dpg_tpu
+--config ...`` spelling keeps working), ``eval``, and ``serve``.
+"""
+
+import sys
+
+
+def main() -> None:
+    cmds = {"train": "r2d2dpg_tpu.train", "eval": "r2d2dpg_tpu.eval",
+            "serve": "r2d2dpg_tpu.serve"}
+    argv = sys.argv[1:]
+    if argv and argv[0] in cmds:
+        name, argv = cmds[argv[0]], argv[1:]
+    else:
+        name = cmds["train"]
+    import importlib
+
+    importlib.import_module(name).main(argv)
+
 
 main()

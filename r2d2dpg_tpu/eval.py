@@ -48,78 +48,37 @@ def _restore_learner(trainer, checkpoint_dir: str):
     latest checkpoint.
 
     The structure template comes from ``jax.eval_shape(trainer.init)`` — no
-    env fleet is constructed and nothing runs — and the restore is orbax
-    ``partial_restore`` of the ``train`` sub-tree only, so the (potentially
-    GBs of) replay arena is never read from disk.  Because env-shaped leaves
+    env fleet is constructed and nothing runs — and the restore is an orbax
+    partial restore of the ``train`` sub-tree only, so the (potentially GBs
+    of) replay arena is never read from disk.  Because env-shaped leaves
     are skipped entirely, checkpoints written with train-time overrides like
     ``--num-envs`` restore fine against the stock config.
+
+    The partial-restore mechanics and the strict leaf validation (VERDICT r4
+    weak #2c) live in ``utils/checkpoint.py`` — shared with the serving
+    hot-reloader, which performs the same restore narrowed further to
+    ``actor_params``.
     """
-    import os
-
     import jax
-    import orbax.checkpoint as ocp
 
-    # orbax requires absolute paths (utils/checkpoint.py does the same).
-    checkpoint_dir = os.path.abspath(checkpoint_dir)
-    template = jax.eval_shape(trainer.init)
-    # Attach explicit shardings to the abstract template: orbax warns that a
-    # restore without sharding info is unsafe across topologies, and the
-    # sharding-free path is format-fragile across orbax versions (ADVICE r1).
-    dev = jax.local_devices()[0]
-    sharding = jax.sharding.SingleDeviceSharding(dev)
-    train_template = jax.tree_util.tree_map(
-        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sharding),
-        template.train,
+    from r2d2dpg_tpu.utils.checkpoint import (
+        abstract_template,
+        check_restored_leaves,
+        restore_subtree,
     )
-    mgr = ocp.CheckpointManager(checkpoint_dir)
-    try:
-        step = mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint found under {checkpoint_dir}")
-        out = mgr.restore(
-            step,
-            args=ocp.args.PyTreeRestore(
-                {"train": train_template}, partial_restore=True
-            ),
-        )
-        restored = out["train"]
-        # A template/checkpoint tree mismatch must fail LOUDLY here, not as
-        # an opaque TypeError later inside the jitted evaluator (VERDICT r4
-        # weak #2c).  Two silent orbax behaviors to catch:
-        #   * missing checkpoint key -> the template leaf comes back
-        #     UNRESTORED (still an abstract ShapeDtypeStruct);
-        #   * shape/dtype mismatch -> orbax ignores the template and hands
-        #     back the CHECKPOINT's array (verified against orbax in-tree:
-        #     a [2,H] twin-critic template restores a [H] single-critic
-        #     checkpoint leaf without complaint).
-        missing, mismatched = [], []
-        for (path, got), want in zip(
-            jax.tree_util.tree_leaves_with_path(restored),
-            jax.tree_util.tree_leaves(train_template),
-        ):
-            if isinstance(got, jax.ShapeDtypeStruct):
-                missing.append(jax.tree_util.keystr(path))
-            elif got.shape != want.shape or got.dtype != want.dtype:
-                mismatched.append(
-                    f"{jax.tree_util.keystr(path)} (checkpoint "
-                    f"{got.dtype}{list(got.shape)} vs expected "
-                    f"{want.dtype}{list(want.shape)})"
-                )
-        if missing or mismatched:
-            def _clip(items):
-                return ", ".join(items[:8]) + (" ..." if len(items) > 8 else "")
-            raise ValueError(
-                f"checkpoint at {checkpoint_dir} (step {step}) does not "
-                "match the restore template's learner tree (wrong "
-                "--compute-dtype or --twin-critic for this checkpoint?): "
-                + (f"{len(missing)} leaves missing: {_clip(missing)}; "
-                   if missing else "")
-                + (f"{len(mismatched)} leaves mismatched: {_clip(mismatched)}"
-                   if mismatched else "")
-            )
-        return restored
-    finally:
-        mgr.close()
+
+    template = jax.eval_shape(trainer.init)
+    train_template = abstract_template(template.train)
+    out, step = restore_subtree(checkpoint_dir, {"train": train_template})
+    restored = out["train"]
+    check_restored_leaves(
+        restored,
+        train_template,
+        where=f"{checkpoint_dir} (step {step})",
+        hint="learner tree — wrong --compute-dtype or --twin-critic for "
+        "this checkpoint?",
+    )
+    return restored
 
 
 def main(argv=None) -> dict:

@@ -3,6 +3,7 @@
 from r2d2dpg_tpu.models.actor_critic import (
     ActorNet,
     CriticNet,
+    policy_step_fn,
     time_major,
     unroll,
     zeros_where_reset,
@@ -14,6 +15,7 @@ __all__ = [
     "ConvTorso",
     "CriticNet",
     "MLPTorso",
+    "policy_step_fn",
     "time_major",
     "unroll",
     "zeros_where_reset",

@@ -273,6 +273,24 @@ class CriticNet(nn.Module):
         return lstm_initial_carry(batch_size, self.hidden, self.use_lstm)
 
 
+def policy_step_fn(actor: "ActorNet") -> Callable[..., Tuple[jnp.ndarray, Carry]]:
+    """Pure single-step policy function for inference-serving callers.
+
+    Returns ``step(params, obs, carry, reset) -> (action, new_carry)`` — a
+    closure over only the static module (hyperparameters), so it is safe to
+    ``jax.jit`` once and reuse across hot-reloaded param versions: params
+    are a traced argument, never baked into the compiled executable.  This
+    is exactly ``actor.apply`` with the argument order the serving batcher
+    threads through its session slabs; it exists so serving code never
+    reaches into flax module internals.
+    """
+
+    def step(params, obs: jnp.ndarray, carry: Carry, reset: jnp.ndarray):
+        return actor.apply(params, obs, carry, reset)
+
+    return step
+
+
 def unroll(
     apply_step: Callable[..., Tuple[jnp.ndarray, Carry]],
     carry: Carry,

@@ -1,0 +1,60 @@
+"""Policy serving subsystem: batched recurrent inference as a service.
+
+Turns a trained R2D2-DPG actor into a request-driven policy service
+(ROADMAP north star: "serves heavy traffic"):
+
+- ``sessions``  — per-client LSTM carries in preallocated device slabs;
+- ``batcher``   — dynamic micro-batching into fixed compile buckets with a
+  flush deadline and bounded-queue admission control;
+- ``reload``    — checkpoint hot-reload polled between batches;
+- ``health``    — queue/latency/staleness snapshot for operators;
+- ``service``   — the orchestrating ``PolicyService`` (one worker thread
+  owns all device work).
+
+Entry point: ``python -m r2d2dpg_tpu serve --config ... --checkpoint-dir
+...`` (JSONL over stdio; see serve.py and docs/SERVING.md).
+"""
+
+from r2d2dpg_tpu.serving.batcher import (
+    OK,
+    SHED_QUEUE,
+    SHED_SESSIONS,
+    SHUTDOWN,
+    MicroBatcher,
+    Request,
+    bucket_for,
+)
+from r2d2dpg_tpu.serving.health import HealthSnapshot
+from r2d2dpg_tpu.serving.reload import CheckpointHotReloader
+from r2d2dpg_tpu.serving.service import (
+    BAD_REQUEST,
+    INTERNAL_ERROR,
+    ActResult,
+    PolicyService,
+)
+from r2d2dpg_tpu.serving.sessions import (
+    SessionSlabs,
+    SessionStore,
+    gather_carries,
+    scatter_carries,
+)
+
+__all__ = [
+    "ActResult",
+    "BAD_REQUEST",
+    "CheckpointHotReloader",
+    "HealthSnapshot",
+    "INTERNAL_ERROR",
+    "MicroBatcher",
+    "OK",
+    "PolicyService",
+    "Request",
+    "SHED_QUEUE",
+    "SHED_SESSIONS",
+    "SHUTDOWN",
+    "SessionSlabs",
+    "SessionStore",
+    "bucket_for",
+    "gather_carries",
+    "scatter_carries",
+]

@@ -1,0 +1,59 @@
+"""Serving health snapshot: what an operator (or load balancer) reads.
+
+One flat dataclass of floats/ints so it drops straight into
+``utils.metrics.MetricLogger.log`` (CSV/TensorBoard) and into the JSONL
+CLI's ``health`` response.  Latency percentiles come from
+``utils.metrics.PercentileWindow`` sliding windows — recent behavior, not
+lifetime averages (a p99 that still remembers the cold-start compile would
+never recover).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthSnapshot:
+    """Point-in-time serving health.
+
+    - ``queue_depth``: requests waiting (bounded by the batcher's max_queue).
+    - ``batch_occupancy``: mean real-rows / bucket-rows over recent batches —
+      how much of each padded policy step was useful work.
+    - ``latency_p50_ms`` / ``latency_p99_ms``: request latency
+      (enqueue -> response) over the recent window.
+    - ``step_p50_ms`` / ``step_p99_ms``: device policy-step latency alone.
+    - ``params_step``: learner step of the params being served (-1 before
+      any load), ``params_staleness_s``: seconds since they were loaded.
+    - ``requests_ok`` / ``requests_shed``: lifetime admission counters —
+      the shed rate is the load-shedding signal.
+    - ``sessions_active`` / ``sessions_evicted``: session-table pressure.
+    - ``worker_errors``: batches the serving worker failed and recovered
+      from (each one dropped all session carries); nonzero means look at
+      ``last_worker_error``.
+    """
+
+    queue_depth: int
+    batch_occupancy: float
+    latency_p50_ms: float
+    latency_p99_ms: float
+    step_p50_ms: float
+    step_p99_ms: float
+    params_step: int
+    params_staleness_s: float
+    requests_ok: int
+    requests_shed: int
+    sessions_active: int
+    sessions_evicted: int
+    worker_errors: int = 0
+    last_reload_error: Optional[str] = None
+    last_worker_error: Optional[str] = None
+
+    def as_scalars(self) -> Dict[str, float]:
+        """Numeric view for ``MetricLogger.log`` (drops the error strings —
+        CSV/TB rows are floats; the errors show in the JSONL/health API)."""
+        out = dataclasses.asdict(self)
+        out.pop("last_reload_error")
+        out.pop("last_worker_error")
+        return {k: float(v) for k, v in out.items()}

@@ -1,0 +1,415 @@
+"""PolicyService: the request-driven front door of a trained R2D2-DPG actor.
+
+Wiring (one worker thread owns ALL device work, so no locks guard params or
+slabs — request threads only enqueue and wait):
+
+    act(session_id, obs) ──> MicroBatcher (bounded queue, pad-to-bucket)
+                                  │ one batch at a time
+                                  ▼
+         jitted policy step: gather carries ─ actor.apply ─ scatter carries
+              ▲ params                                  │ actions
+              │                                         ▼
+    CheckpointHotReloader.poll()  (between batches)   Request.finish()
+
+The jitted step closes over the static actor module only
+(``models.policy_step_fn``); params and the session slabs are traced
+arguments, so a hot-reload is literally swapping one pytree reference
+between batches — no recompile, no dropped session state.  The slabs are
+donated through the step like the trainer's arena (one live copy in HBM).
+
+Degradation ladder under load: fill buckets better (bigger batches, same
+compile) -> queue up to ``max_queue`` -> shed with ``SHED_QUEUE``.  Session
+capacity sheds with ``SHED_SESSIONS`` after a TTL sweep.  Both are response
+CODES, not exceptions: overload is an expected state, not an error.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from r2d2dpg_tpu.models.actor_critic import ActorNet, policy_step_fn
+from r2d2dpg_tpu.serving.batcher import (
+    OK,
+    SHED_QUEUE,
+    SHED_SESSIONS,
+    SHUTDOWN,
+    MicroBatcher,
+    Request,
+    bucket_for,
+)
+from r2d2dpg_tpu.serving.health import HealthSnapshot
+from r2d2dpg_tpu.serving.reload import CheckpointHotReloader
+from r2d2dpg_tpu.serving.sessions import (
+    SessionStore,
+    gather_carries,
+    scatter_carries,
+)
+from r2d2dpg_tpu.utils.metrics import MetricLogger, PercentileWindow
+
+BAD_REQUEST = "bad_request"
+INTERNAL_ERROR = "internal_error"
+
+
+@dataclasses.dataclass(frozen=True)
+class ActResult:
+    """What a client gets back from ``act``: a code, and on OK the action
+    plus the learner step of the params that computed it."""
+
+    code: str
+    action: Optional[np.ndarray]
+    params_step: int
+    latency_s: float
+
+
+class PolicyService:
+    """Batched recurrent policy inference with sessions and hot-reload.
+
+    Either pass concrete ``params`` (tests, frozen deployments) or a
+    ``reloader`` (live deployments — initial params come from
+    ``reloader.load_latest()`` and refresh on its poll cadence).
+    """
+
+    def __init__(
+        self,
+        actor: ActorNet,
+        params: Any = None,
+        *,
+        obs_shape: Optional[Tuple[int, ...]] = None,
+        max_sessions: int = 64,
+        bucket_sizes: Sequence[int] = (1, 2, 4, 8, 16, 32),
+        max_queue: int = 256,
+        flush_ms: float = 5.0,
+        session_ttl_s: float = 300.0,
+        reloader: Optional[CheckpointHotReloader] = None,
+        params_step: int = -1,
+        logger: Optional[MetricLogger] = None,
+        log_every_s: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if params is None and reloader is None:
+            raise ValueError("need initial params or a reloader")
+        self.actor = actor
+        self.obs_shape = tuple(obs_shape) if obs_shape is not None else None
+        self._clock = clock
+        self.sessions = SessionStore(
+            max_sessions, actor.initial_carry, ttl_s=session_ttl_s, clock=clock
+        )
+        self.batcher = MicroBatcher(
+            bucket_sizes, max_queue=max_queue, flush_ms=flush_ms, clock=clock
+        )
+        self.reloader = reloader
+        self._params = (
+            params if params is not None else reloader.load_latest()
+        )
+        self._params_step = (
+            reloader.current_step
+            if (params is None and reloader is not None)
+            else params_step
+        )
+        self._slabs = self.sessions.init_slabs()
+        step = policy_step_fn(actor)
+
+        def _batch_step(p, slabs, slots, obs, reset):
+            carry = gather_carries(slabs, slots)
+            action, new_carry = step(p, obs, carry, reset)
+            return action, scatter_carries(slabs, slots, new_carry)
+
+        # One executable per bucket size (jit caches on shapes); the slabs
+        # are donated through every call — a single live copy in HBM, same
+        # as the trainer donating its arena.
+        self._step = jax.jit(_batch_step, donate_argnums=(1,))
+
+        self._logger = logger
+        self._log_every_s = log_every_s
+        self._last_log_t = clock()
+        self._latency_win = PercentileWindow()
+        self._step_win = PercentileWindow()
+        self._occupancy_ema = 0.0
+        self._requests_ok = 0
+        self._batches = 0
+        self._worker_errors = 0
+        self._shed_sessions = 0
+        self._last_worker_error: Optional[str] = None
+        # Worker-only: locked in by the first served batch when no
+        # obs_shape was configured (see the screening in _run_batch).
+        self._inferred_obs_shape: Optional[Tuple[int, ...]] = None
+        self._stats_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self, *, warmup: bool = True) -> "PolicyService":
+        if self._thread is not None:
+            raise RuntimeError("service already started")
+        if self._stop.is_set():
+            # The batcher closed during shutdown and all carries are
+            # orphaned; a "restarted" instance would shed 100% of traffic
+            # while looking healthy.  Make the lifecycle one-way, loudly.
+            raise RuntimeError(
+                "service was stopped and cannot restart; build a new "
+                "PolicyService"
+            )
+        if warmup:
+            self.warmup()
+        self._thread = threading.Thread(
+            target=self._serve_loop, name="policy-serve", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self) -> "PolicyService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def warmup(self) -> None:
+        """Compile every bucket up front (all rows pointed at the scratch
+        slot) so the first real request never pays an XLA compile inside
+        its flush window."""
+        if self.obs_shape is None:
+            return  # nothing to synthesize observations from
+        for b in self.batcher.bucket_sizes:
+            slots = jnp.full((b,), self.sessions.scratch_slot, jnp.int32)
+            obs = jnp.zeros((b,) + self.obs_shape, jnp.float32)
+            reset = jnp.ones((b,), jnp.float32)
+            action, self._slabs = self._step(
+                self._params, self._slabs, slots, obs, reset
+            )
+        jax.block_until_ready(action)
+
+    # ------------------------------------------------------------------- act
+    def act_async(
+        self, session_id: str, obs: np.ndarray, *, reset: bool = False
+    ) -> Request:
+        """Enqueue one step; returns the request-future (``.wait()`` then
+        read ``.code`` / ``.action``).  Sheds synchronously on a full queue."""
+        obs = np.asarray(obs, np.float32)
+        req = Request(
+            session_id=str(session_id),
+            obs=obs,
+            reset=reset,
+            enqueued_at=self._clock(),
+        )
+        if self.obs_shape is not None and obs.shape != self.obs_shape:
+            req.finish(BAD_REQUEST, clock=self._clock)
+            return req
+        if self._thread is None or self._stop.is_set():
+            req.finish(SHUTDOWN, clock=self._clock)
+            return req
+        if not self.batcher.submit(req):
+            # Refusal is either the admission bound or a shutdown race —
+            # tell the client which (a shed invites backoff-and-retry, a
+            # shutdown doesn't).
+            code = SHUTDOWN if self.batcher.closed else SHED_QUEUE
+            req.finish(code, clock=self._clock)
+            return req
+        return req
+
+    def act(
+        self,
+        session_id: str,
+        obs: np.ndarray,
+        *,
+        reset: bool = False,
+        timeout: Optional[float] = 30.0,
+    ) -> ActResult:
+        """Blocking act(): one policy step for this session's stream."""
+        req = self.act_async(session_id, obs, reset=reset)
+        if not req.wait(timeout):
+            # Leave the request in flight (the worker will still finish it);
+            # the client just stops waiting.  No code exists for this state
+            # because the server did not drop anything.
+            return ActResult("timeout", None, -1, self._clock() - req.enqueued_at)
+        return ActResult(req.code, req.action, req.params_step, req.latency_s)
+
+    def end_session(self, session_id: str) -> bool:
+        """Client goodbye: free the slot without waiting for TTL."""
+        return self.sessions.release(str(session_id))
+
+    # ------------------------------------------------------------ the worker
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            # The worker must outlive any single failure (a dead worker
+            # would turn every later act() into a silent hang), but the
+            # blast radius differs: housekeeping (reload poll, TTL sweep,
+            # health logging — e.g. a full --logdir volume) never touches
+            # the donated slabs, so it is noted and skipped WITHOUT
+            # dropping session state; only a failed batch execution may
+            # have consumed the slabs and forces the rebuild.
+            try:
+                self._between_batches()
+            except Exception as e:  # noqa: BLE001
+                self._note_worker_error(e)
+            batch = None
+            try:
+                batch = self.batcher.next_batch()
+                if batch:
+                    self._run_batch(batch)
+            except Exception as e:  # noqa: BLE001
+                self._recover_from_worker_error(e, batch)
+        for req in self.batcher.drain():
+            req.finish(SHUTDOWN, clock=self._clock)
+
+    def _note_worker_error(self, exc: Exception) -> None:
+        with self._stats_lock:
+            self._worker_errors += 1
+            self._last_worker_error = f"{type(exc).__name__}: {exc}"
+
+    def _recover_from_worker_error(self, exc: Exception, batch) -> None:
+        """Fail the affected requests, rebuild device state, keep serving.
+
+        A jit call that raised AFTER argument donation may have consumed the
+        carry slabs, so they are rebuilt from scratch and every session is
+        dropped (their carries are gone either way; each client's next
+        request re-allocates with a fresh, reset carry).  The error is
+        surfaced in the health snapshot, not swallowed.
+        """
+        self._note_worker_error(exc)
+        for req in batch or []:
+            if not req.done:
+                req.finish(INTERNAL_ERROR, clock=self._clock)
+        try:
+            self._slabs = self.sessions.init_slabs()
+            self.sessions.clear()
+        except Exception as e:  # pragma: no cover - alloc failure is fatal
+            with self._stats_lock:
+                self._last_worker_error = f"unrecoverable: {type(e).__name__}: {e}"
+            self._stop.set()
+
+    def _between_batches(self) -> None:
+        """Duties that must never interleave with a policy step: param swap
+        (atomic by construction — this thread runs the steps), TTL sweep,
+        health logging."""
+        if self.reloader is not None:
+            fresh = self.reloader.poll()
+            if fresh is not None:
+                self._params = fresh
+                self._params_step = self.reloader.current_step
+        self.sessions.evict_expired()
+        if (
+            self._logger is not None
+            and self._clock() - self._last_log_t >= self._log_every_s
+        ):
+            self._last_log_t = self._clock()
+            self._logger.log(self._batches, self.health().as_scalars())
+
+    def _run_batch(self, batch) -> None:
+        # Screen shapes BEFORE stacking: without a configured ``obs_shape``
+        # act_async admits anything, and one ragged observation must fail
+        # as that client's bad request — not blow up np.stack (or the jit
+        # call) in the worker and cost every session its carry.  The first
+        # request ever served sets the expectation (one service serves one
+        # net) and it sticks across batches.
+        expect = self.obs_shape or self._inferred_obs_shape
+        screened = []
+        for req in batch:
+            if expect is None:
+                expect = req.obs.shape
+            if req.obs.shape != expect:
+                req.finish(BAD_REQUEST, clock=self._clock)
+                continue
+            screened.append(req)
+        self._inferred_obs_shape = expect
+        # Admit: resolve slots (alloc on first sight; shed on a full table).
+        admitted = []
+        slots = []
+        resets = []
+        for req in screened:
+            got = self.sessions.acquire(req.session_id)
+            if got is None:
+                with self._stats_lock:
+                    self._shed_sessions += 1
+                req.finish(SHED_SESSIONS, clock=self._clock)
+                continue
+            slot, is_new = got
+            admitted.append(req)
+            slots.append(slot)
+            # A brand-new slot may hold a dead session's carry; reset=1 makes
+            # the actor zero it inside the step (zeros_where_reset), exactly
+            # the training-time episode-boundary mechanic.
+            resets.append(1.0 if (is_new or req.reset) else 0.0)
+        if not admitted:
+            return
+        n = len(admitted)
+        bucket = bucket_for(n, self.batcher.bucket_sizes)
+        pad = bucket - n
+        slot_arr = np.asarray(
+            slots + [self.sessions.scratch_slot] * pad, np.int32
+        )
+        obs_arr = np.stack(
+            [r.obs for r in admitted]
+            + [np.zeros_like(admitted[0].obs)] * pad
+        )
+        reset_arr = np.asarray(resets + [1.0] * pad, np.float32)
+
+        t0 = self._clock()
+        action, self._slabs = self._step(
+            self._params, self._slabs, slot_arr, obs_arr, reset_arr
+        )
+        action = np.asarray(jax.device_get(action))
+        step_s = self._clock() - t0
+
+        for i, req in enumerate(admitted):
+            req.finish(
+                OK, action[i], self._params_step, clock=self._clock
+            )
+        with self._stats_lock:
+            self._requests_ok += n
+            self._batches += 1
+            self._occupancy_ema = (
+                0.9 * self._occupancy_ema + 0.1 * (n / bucket)
+                if self._batches > 1
+                else n / bucket
+            )
+        self._step_win.add(step_s)
+        for req in admitted:
+            self._latency_win.add(req.latency_s)
+
+    # ---------------------------------------------------------------- health
+    def health(self) -> HealthSnapshot:
+        lat50, lat99 = self._latency_win.percentiles((50.0, 99.0))
+        st50, st99 = self._step_win.percentiles((50.0, 99.0))
+        with self._stats_lock:
+            ok, occ = self._requests_ok, self._occupancy_ema
+            errs, last_err = self._worker_errors, self._last_worker_error
+            shed_sessions = self._shed_sessions
+        staleness = (
+            self.reloader.staleness_s() if self.reloader is not None else 0.0
+        )
+        return HealthSnapshot(
+            queue_depth=self.batcher.depth,
+            batch_occupancy=occ,
+            latency_p50_ms=lat50 * 1e3,
+            latency_p99_ms=lat99 * 1e3,
+            step_p50_ms=st50 * 1e3,
+            step_p99_ms=st99 * 1e3,
+            params_step=(
+                int(self._params_step) if self._params_step is not None else -1
+            ),
+            params_staleness_s=staleness,
+            requests_ok=ok,
+            # BOTH load-shedding modes count — an operator watching the
+            # shed rate must see session-capacity refusals too.
+            requests_shed=self.batcher.shed_queue_full + shed_sessions,
+            sessions_active=self.sessions.active,
+            sessions_evicted=self.sessions.evictions,
+            worker_errors=errs,
+            last_reload_error=(
+                self.reloader.last_error if self.reloader is not None else None
+            ),
+            last_worker_error=last_err,
+        )

@@ -1,0 +1,192 @@
+"""Per-session recurrent-state store: preallocated device-resident slabs.
+
+THE R2D2 serving problem: a recurrent policy's action depends on the LSTM
+carry accumulated over the whole session, so a policy service is stateful
+per client.  Keeping one small ``(c, h)`` pair per session as separate
+device arrays would fragment HBM and force a gather/concat on every batch;
+instead the store follows the ``ReplayArena`` slab idiom (replay/arena.py):
+ONE preallocated ``[max_sessions + 1, ...]`` buffer per carry leaf, with
+per-batch access as an indexed gather/scatter that lives *inside* the
+jitted policy step — no host round-trip ever touches a carry.
+
+Row ``max_sessions`` (``scratch_slot``) is a write-only scratch row: the
+micro-batcher pads every bucket to its static size by pointing padding rows
+at it, so the scatter needs no validity mask (duplicate scatter writes to
+the scratch row are don't-cares).
+
+Slot bookkeeping (which client owns which row, TTL) is host-side and cheap:
+a dict + free-list guarded by a lock.  Freed rows are NOT zeroed on the
+device — a new session's first request carries ``reset=1`` and the actor
+zeroes the carry *inside* the step (``zeros_where_reset``), exactly the
+episode-boundary mechanic training uses, so slab hygiene costs nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Carry = Any
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SessionSlabs:
+    """Device-resident carry storage: a pytree with ``[S + 1, ...]`` leaves
+    (``S = max_sessions``; the extra row is the padding scratch row).  Empty
+    pytree for feedforward actors — gather/scatter degrade to no-ops."""
+
+    carries: Carry
+
+
+def gather_carries(slabs: SessionSlabs, slots: jnp.ndarray) -> Carry:
+    """Read the carries for one batch of slot indices (jit-safe)."""
+    return jax.tree_util.tree_map(lambda buf: buf[slots], slabs.carries)
+
+
+def scatter_carries(
+    slabs: SessionSlabs, slots: jnp.ndarray, carries: Carry
+) -> SessionSlabs:
+    """Write updated carries back at ``slots`` (jit-safe; donation-friendly).
+
+    Padding rows all point at the scratch row; ``.at[].set`` with duplicate
+    indices is nondeterministic about which write wins, which is fine there
+    — the scratch row is never read as real state.
+    """
+    return SessionSlabs(
+        carries=jax.tree_util.tree_map(
+            lambda buf, new: buf.at[slots].set(new), slabs.carries, carries
+        )
+    )
+
+
+@dataclasses.dataclass
+class _SlotInfo:
+    slot: int
+    last_used: float
+
+
+class SessionStore:
+    """Host-side session table over a fixed pool of slab rows.
+
+    The instance holds static config plus the slot map; the device slabs are
+    a separate ``SessionSlabs`` pytree threaded through the jitted policy
+    step by the service (same state-outside-the-object discipline as
+    ``ReplayArena``).
+
+    TTL eviction is lazy: expired sessions are swept on every allocation
+    attempt (and on demand via ``evict_expired``), so an idle service holds
+    stale rows but a full one always reclaims them before shedding.
+    """
+
+    def __init__(
+        self,
+        max_sessions: int,
+        initial_carry_fn: Callable[[int], Carry],
+        *,
+        ttl_s: float = 300.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if max_sessions < 1:
+            raise ValueError("max_sessions must be >= 1")
+        self.max_sessions = max_sessions
+        self.ttl_s = ttl_s
+        self._initial_carry_fn = initial_carry_fn
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._by_id: Dict[str, _SlotInfo] = {}
+        self._free: List[int] = list(range(max_sessions - 1, -1, -1))
+        self._evictions = 0
+
+    # ----------------------------------------------------------------- slabs
+    @property
+    def scratch_slot(self) -> int:
+        return self.max_sessions
+
+    def init_slabs(self) -> SessionSlabs:
+        """Preallocate the carry slabs (zeros; see module docstring on why
+        rows never need re-zeroing afterwards)."""
+        example = self._initial_carry_fn(1)
+
+        def alloc(leaf):
+            return jnp.zeros(
+                (self.max_sessions + 1,) + leaf.shape[1:], leaf.dtype
+            )
+
+        return SessionSlabs(
+            carries=jax.tree_util.tree_map(alloc, example)
+        )
+
+    # ----------------------------------------------------------------- slots
+    def acquire(self, session_id: str) -> Optional[Tuple[int, bool]]:
+        """Slot for ``session_id``, allocating on first sight.
+
+        Returns ``(slot, is_new)``, or ``None`` when the table is full even
+        after TTL eviction (the caller sheds the request).  Touches the
+        session's TTL clock.
+        """
+        now = self._clock()
+        with self._lock:
+            info = self._by_id.get(session_id)
+            if info is not None:
+                info.last_used = now
+                return info.slot, False
+            if not self._free:
+                self._evict_expired_locked(now)
+            if not self._free:
+                return None
+            slot = self._free.pop()
+            self._by_id[session_id] = _SlotInfo(slot=slot, last_used=now)
+            return slot, True
+
+    def release(self, session_id: str) -> bool:
+        """Explicitly end a session (client said goodbye); True if it existed."""
+        with self._lock:
+            info = self._by_id.pop(session_id, None)
+            if info is None:
+                return False
+            self._free.append(info.slot)
+            return True
+
+    def evict_expired(self) -> int:
+        """Sweep sessions idle for longer than ``ttl_s``; returns count."""
+        with self._lock:
+            return self._evict_expired_locked(self._clock())
+
+    def clear(self) -> int:
+        """Drop EVERY session (service-side state-loss recovery: the caller
+        just rebuilt the slabs, so all carries are gone; clients' next
+        request re-allocates with ``is_new`` -> reset).  Returns count."""
+        with self._lock:
+            n = len(self._by_id)
+            for info in self._by_id.values():
+                self._free.append(info.slot)
+            self._by_id.clear()
+            self._evictions += n
+            return n
+
+    def _evict_expired_locked(self, now: float) -> int:
+        dead = [
+            sid
+            for sid, info in self._by_id.items()
+            if now - info.last_used > self.ttl_s
+        ]
+        for sid in dead:
+            self._free.append(self._by_id.pop(sid).slot)
+        self._evictions += len(dead)
+        return len(dead)
+
+    # ----------------------------------------------------------------- stats
+    @property
+    def active(self) -> int:
+        with self._lock:
+            return len(self._by_id)
+
+    @property
+    def evictions(self) -> int:
+        return self._evictions

@@ -1,12 +1,21 @@
 """Auxiliary subsystems (SURVEY.md §5): checkpointing, metrics, profiling."""
 
-from r2d2dpg_tpu.utils.checkpoint import CheckpointManager
-from r2d2dpg_tpu.utils.metrics import MetricLogger
+from r2d2dpg_tpu.utils.checkpoint import (
+    CheckpointManager,
+    abstract_template,
+    check_restored_leaves,
+    restore_subtree,
+)
+from r2d2dpg_tpu.utils.metrics import MetricLogger, PercentileWindow
 from r2d2dpg_tpu.utils.profiling import nan_debug, profile_trace
 
 __all__ = [
     "CheckpointManager",
     "MetricLogger",
+    "PercentileWindow",
+    "abstract_template",
+    "check_restored_leaves",
     "nan_debug",
     "profile_trace",
+    "restore_subtree",
 ]

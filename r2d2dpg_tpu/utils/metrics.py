@@ -13,10 +13,55 @@ TensorBoard scalars (SURVEY §2.7/§5.5).  The build logs:
 
 from __future__ import annotations
 
+import collections
 import csv
+import math
 import os
+import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Iterable, Optional, Tuple
+
+
+class PercentileWindow:
+    """Sliding window of scalar observations with percentile read-off.
+
+    Serving health (queue wait, policy-step latency) needs p50/p99 over the
+    *recent* past, not the whole process lifetime — a bounded deque of the
+    last ``size`` observations is that window.  ``add`` is O(1);
+    ``percentiles`` sorts the window (a few thousand floats) only when a
+    snapshot is actually taken.  Thread-safe: producers (the serving worker)
+    and consumers (health scrapes from request threads) run concurrently.
+    """
+
+    def __init__(self, size: int = 2048):
+        if size < 1:
+            raise ValueError("size must be >= 1")
+        self._buf: collections.deque = collections.deque(maxlen=size)
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def add(self, value: float) -> None:
+        with self._lock:
+            self._buf.append(float(value))
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        """Total observations ever added (not just those still windowed)."""
+        return self._count
+
+    def percentiles(self, qs: Iterable[float] = (50.0, 99.0)) -> Tuple[float, ...]:
+        """Nearest-rank percentiles over the current window (0.0 if empty)."""
+        with self._lock:
+            data = sorted(self._buf)
+        if not data:
+            return tuple(0.0 for _ in qs)
+        out = []
+        for q in qs:
+            # Nearest-rank: ceil(q/100 * n) - 1, clamped to the window.
+            rank = math.ceil(q / 100.0 * len(data)) - 1
+            out.append(data[max(0, min(len(data) - 1, rank))])
+        return tuple(out)
 
 
 class MetricLogger:

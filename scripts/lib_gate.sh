@@ -69,6 +69,35 @@ run_evidence() {
       return 0
     fi
     wait_on_box "$waitpat"
+    # One-time migration (ADVICE r5 #3): run dirs whose train completed
+    # BEFORE the .train_complete stamp existed would be rm -rf'd and
+    # retrained from scratch if ever re-armed.  The completed-train
+    # evidence lives in the driver logs next to the run dir ("<dir>
+    # attempt N train done rc=0", echoed by this function and by the
+    # older private-copy drivers into their exec-redirected logs).  Only
+    # the dir's LAST logged train event counts: a stale "done rc=0" must
+    # not bless a later attempt's dir that was preempted mid-budget.
+    # Chronology is only knowable WITHIN one log file, so every file gets
+    # a vote and any file whose last event is not "done rc=0" vetoes
+    # (e.g. the relaunch's "train start" with no matching done).
+    # grep -F: fixed-string, so regex metachars in $dir can't mis-match.
+    if ! [ -f "$dir/.train_complete" ] && [ -d "$dir" ]; then
+      local _mig_log _mig_last _mig_verdict=""
+      for _mig_log in "$(dirname "$dir")"/*.log; do
+        [ -f "$_mig_log" ] || continue
+        _mig_last=$(grep -F -- "$dir attempt" "$_mig_log" 2>/dev/null \
+                      | grep -F " train " | tail -1)
+        [ -z "$_mig_last" ] && continue
+        case "$_mig_last" in
+          *" train done rc=0 "*) [ -z "$_mig_verdict" ] && _mig_verdict=stamp ;;
+          *) _mig_verdict=veto ;;
+        esac
+      done
+      if [ "$_mig_verdict" = stamp ]; then
+        echo "$dir: pre-stamp completed train found in logs; stamping .train_complete $(date)"
+        touch "$dir/.train_complete"
+      fi
+    fi
     if ! [ -f "$dir/.train_complete" ]; then
       echo "=== $dir attempt $attempt train start ($*) $(date) ==="
       rm -rf "$dir"

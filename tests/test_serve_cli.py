@@ -1,0 +1,106 @@
+"""serve CLI: flag plumbing (fast) and the stdio/selftest loops (slow,
+subprocess — covers the ``python -m r2d2dpg_tpu serve`` dispatch too)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from r2d2dpg_tpu.serve import parse_args
+
+pytestmark = pytest.mark.serving
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_args_plumbing():
+    args = parse_args(
+        [
+            "--config", "pendulum_tiny", "--checkpoint-dir", "ck",
+            "--bucket-sizes", "2,8", "--flush-ms", "1.5", "--max-queue", "7",
+            "--max-sessions", "3", "--session-ttl", "9", "--poll-every", "0.5",
+        ]
+    )
+    assert args.config == "pendulum_tiny" and args.checkpoint_dir == "ck"
+    assert args.bucket_sizes == "2,8" and args.flush_ms == 1.5
+    assert (args.max_queue, args.max_sessions) == (7, 3)
+    assert (args.session_ttl, args.poll_every) == (9.0, 0.5)
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir(tmp_path_factory):
+    """A real pendulum_tiny light checkpoint for the subprocess to serve."""
+    from r2d2dpg_tpu.configs import get_config
+    from r2d2dpg_tpu.utils.checkpoint import CheckpointManager
+
+    cfg = get_config("pendulum_tiny")
+    state = cfg.build().init()
+    d = str(tmp_path_factory.mktemp("serve") / "ckpt")
+    mgr = CheckpointManager(d, save_every=1, light=True)
+    mgr.save(5, state)
+    mgr.wait()
+    mgr.close()
+    return d
+
+
+def _serve_env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("R2D2DPG_PALLAS_INTERPRET", "1")
+    return env
+
+
+@pytest.mark.slow
+def test_serve_stdio_loop_end_to_end(ckpt_dir):
+    lines = "\n".join(
+        [
+            json.dumps({"session": "u1", "obs": [0.1, 0.2, 0.3], "reset": True}),
+            json.dumps({"session": "u1", "obs": [0.2, 0.3, 0.4]}),
+            json.dumps({"cmd": "health"}),
+            json.dumps({"cmd": "end_session", "session": "u1"}),
+            "not json",
+            # Valid JSON, poisonous payloads: each must answer THIS client
+            # with a code, not crash the server (np.asarray raises on
+            # strings; a non-object line has no .get).
+            json.dumps({"session": "u9", "obs": ["boom"]}),
+            json.dumps([1, 2, 3]),
+            json.dumps({"cmd": "quit"}),
+        ]
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "r2d2dpg_tpu", "serve",
+         "--config", "pendulum_tiny", "--checkpoint-dir", ckpt_dir,
+         "--flush-ms", "1", "--selftest", "0"],
+        input=lines, capture_output=True, text=True, cwd=HERE,
+        env=_serve_env(), timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = [json.loads(l) for l in proc.stdout.strip().splitlines()]
+    assert len(out) == 7
+    act1, act2, health, ended, bad_json, bad_obs, bad_type = out
+    assert act1["code"] == "ok" and len(act1["action"]) == 1
+    assert act1["params_step"] == 5 and act2["code"] == "ok"
+    assert health["params_step"] == 5 and health["requests_ok"] == 2
+    assert ended == {"code": "ok", "released": True}
+    assert bad_json["code"] == "bad_request"
+    assert bad_obs["code"] == "bad_request" and "ValueError" in bad_obs["error"]
+    assert bad_type["code"] == "bad_request"
+
+
+@pytest.mark.slow
+def test_serve_selftest_smoke(ckpt_dir):
+    proc = subprocess.run(
+        [sys.executable, "-m", "r2d2dpg_tpu", "serve",
+         "--config", "pendulum_tiny", "--checkpoint-dir", ckpt_dir,
+         "--flush-ms", "1", "--selftest", "24"],
+        capture_output=True, text=True, cwd=HERE, env=_serve_env(),
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["selftest"] == 24
+    assert rec["codes"] == {"ok": 24}
+    assert rec["params_step"] == 5 and rec["sessions_active"] == 8

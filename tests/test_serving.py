@@ -1,0 +1,365 @@
+"""Serving subsystem tests (ISSUE 1): session isolation, micro-batching,
+admission control, hot-reload validation, health.
+
+Everything here is CPU-fast; the end-to-end acceptance flows (real
+checkpoints, mid-stream reload, soak) live in test_serving_e2e.py.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.models import ActorNet, policy_step_fn
+from r2d2dpg_tpu.serving import (
+    BAD_REQUEST,
+    MicroBatcher,
+    PolicyService,
+    Request,
+    SessionStore,
+    bucket_for,
+)
+from r2d2dpg_tpu.serving.batcher import OK, SHED_QUEUE, SHED_SESSIONS
+from r2d2dpg_tpu.utils.metrics import PercentileWindow
+
+pytestmark = pytest.mark.serving
+
+OBS = (5,)
+ACT = 3
+
+
+def make_actor(use_lstm=True, hidden=32):
+    return ActorNet(action_dim=ACT, hidden=hidden, use_lstm=use_lstm)
+
+
+def init_params(actor, seed=1):
+    return actor.init(
+        jax.random.PRNGKey(seed),
+        jnp.zeros((1,) + OBS),
+        actor.initial_carry(1),
+        jnp.zeros((1,)),
+    )
+
+
+def make_service(actor=None, params=None, **kw):
+    actor = actor or make_actor()
+    params = params if params is not None else init_params(actor)
+    kw.setdefault("obs_shape", OBS)
+    kw.setdefault("max_sessions", 8)
+    kw.setdefault("bucket_sizes", (1, 2, 4, 8))
+    kw.setdefault("flush_ms", 1.0)
+    return PolicyService(actor, params, **kw)
+
+
+def reference_rollout(actor, params, obs_seq):
+    """Sequential UNBATCHED rollout: the ground truth serving must match."""
+    carry = actor.initial_carry(1)
+    step = jax.jit(policy_step_fn(actor))
+    out = []
+    for t in range(obs_seq.shape[0]):
+        a, carry = step(
+            params,
+            obs_seq[t][None],
+            carry,
+            jnp.asarray([1.0 if t == 0 else 0.0]),
+        )
+        out.append(np.asarray(a[0]))
+    return out
+
+
+# --------------------------------------------------------------------- units
+def test_bucket_for_picks_smallest_covering():
+    sizes = (1, 2, 4, 8)
+    assert bucket_for(1, sizes) == 1
+    assert bucket_for(3, sizes) == 4
+    assert bucket_for(8, sizes) == 8
+    with pytest.raises(ValueError):
+        bucket_for(9, sizes)
+
+
+def test_percentile_window_nearest_rank():
+    w = PercentileWindow(size=100)
+    for v in range(1, 101):  # 1..100
+        w.add(float(v))
+    p50, p99 = w.percentiles((50.0, 99.0))
+    assert p50 == 50.0 and p99 == 99.0
+    assert PercentileWindow().percentiles((50.0,)) == (0.0,)
+    # Window slides: old observations age out.
+    w2 = PercentileWindow(size=4)
+    for v in (1.0, 1.0, 1.0, 9.0, 9.0, 9.0, 9.0):
+        w2.add(v)
+    assert w2.percentiles((50.0,)) == (9.0,)
+    assert w2.count == 7
+
+
+def test_session_store_alloc_touch_ttl_evict():
+    t = [0.0]
+    store = SessionStore(
+        2, make_actor().initial_carry, ttl_s=10.0, clock=lambda: t[0]
+    )
+    slot_a, new_a = store.acquire("a")
+    assert new_a and store.active == 1
+    assert store.acquire("a") == (slot_a, False)  # sticky + touch
+    store.acquire("b")
+    assert store.acquire("c") is None  # full, nothing expired
+    t[0] = 11.0  # both now idle > ttl ... but "a" was touched at t=0
+    slot_c, new_c = store.acquire("c")  # evicts expired, reuses a slot
+    assert new_c and store.active == 1 and store.evictions == 2
+    assert store.release("c") and not store.release("zzz")
+    assert store.active == 0
+
+
+def test_session_slabs_shapes_and_scratch_row():
+    actor = make_actor(hidden=16)
+    store = SessionStore(4, actor.initial_carry)
+    slabs = store.init_slabs()
+    for leaf in jax.tree_util.tree_leaves(slabs):
+        assert leaf.shape[0] == 5  # max_sessions + scratch row
+    assert store.scratch_slot == 4
+    # Feedforward actor: empty carry pytree, no slab leaves.
+    ff_store = SessionStore(4, make_actor(use_lstm=False).initial_carry)
+    assert jax.tree_util.tree_leaves(ff_store.init_slabs()) == []
+
+
+def test_batcher_bounded_queue_sheds():
+    b = MicroBatcher((1, 2), max_queue=2, flush_ms=0.0)
+    mk = lambda s: Request(s, np.zeros(OBS), False, time.monotonic())  # noqa: E731
+    assert b.submit(mk("a")) and b.submit(mk("b"))
+    assert not b.submit(mk("c"))  # full -> immediate refusal, no block
+    assert b.shed_queue_full == 1 and b.depth == 2
+
+
+def test_batcher_one_request_per_session_per_batch():
+    b = MicroBatcher((4,), max_queue=16, flush_ms=0.0)
+    r1 = Request("s", np.zeros(OBS), False, time.monotonic())
+    r2 = Request("s", np.zeros(OBS), False, time.monotonic())
+    r3 = Request("t", np.zeros(OBS), False, time.monotonic())
+    for r in (r1, r2, r3):
+        assert b.submit(r)
+    first = b.next_batch(poll_s=0.0)
+    assert [r.session_id for r in first] == ["s", "t"]
+    assert first[0] is r1  # FIFO within the session
+    second = b.next_batch(poll_s=0.0)
+    assert second == [r2]  # holdover rides the next batch
+
+
+# ------------------------------------------------------------------- service
+def test_interleaved_sessions_match_sequential_rollouts():
+    """Two sessions interleaved through the micro-batcher must reproduce the
+    same action sequences as two sequential single-session rollouts."""
+    actor = make_actor()
+    params = init_params(actor)
+    rng = np.random.default_rng(0)
+    obs = {
+        s: rng.standard_normal((5,) + OBS).astype(np.float32) for s in "ab"
+    }
+    got = {s: [] for s in "ab"}
+    with make_service(actor, params) as svc:
+        for t in range(5):
+            pending = [
+                (s, svc.act_async(s, obs[s][t], reset=(t == 0))) for s in "ab"
+            ]
+            for s, req in pending:
+                assert req.wait(30.0)
+                assert req.code == OK
+                got[s].append(req.action)
+    for s in "ab":
+        want = reference_rollout(actor, params, obs[s])
+        for t in range(5):
+            np.testing.assert_array_equal(got[s][t], want[t])
+
+
+def test_feedforward_actor_serves_too():
+    actor = make_actor(use_lstm=False)
+    params = init_params(actor)
+    obs = np.ones(OBS, np.float32)
+    with make_service(actor, params) as svc:
+        res = svc.act("x", obs)
+    assert res.code == OK
+    direct, _ = actor.apply(params, obs[None], (), jnp.zeros((1,)))
+    np.testing.assert_array_equal(res.action, np.asarray(direct[0]))
+
+
+def test_queue_full_returns_shed_code_not_exception():
+    # max_queue=0: every request sheds immediately — the admission-control
+    # contract is a CODE on the result, never a raise.
+    with make_service(max_queue=0) as svc:
+        res = svc.act("a", np.zeros(OBS, np.float32))
+    assert res.code == SHED_QUEUE
+    assert res.action is None
+    assert svc.health().requests_shed == 1
+
+
+def test_session_capacity_sheds_with_session_code():
+    with make_service(max_sessions=1, session_ttl_s=1e9) as svc:
+        r1 = svc.act("a", np.zeros(OBS, np.float32))
+        r2 = svc.act("b", np.zeros(OBS, np.float32))
+        h = svc.health()
+    assert r1.code == OK
+    assert r2.code == SHED_SESSIONS and r2.action is None
+    assert h.requests_shed == 1  # session-capacity sheds count as sheds too
+
+
+def test_bad_obs_shape_is_rejected_before_queueing():
+    with make_service() as svc:
+        res = svc.act("a", np.zeros((7,), np.float32))
+    assert res.code == BAD_REQUEST
+
+
+def test_act_after_stop_returns_shutdown():
+    svc = make_service()
+    svc.start(warmup=False)
+    svc.stop()
+    assert svc.act("a", np.zeros(OBS, np.float32)).code == "shutdown"
+
+
+def test_same_session_concurrent_requests_stay_ordered():
+    """A client pipelining 2 steps of one session must see them applied in
+    order (the batcher serializes same-session requests across batches)."""
+    actor = make_actor()
+    params = init_params(actor)
+    rng = np.random.default_rng(1)
+    obs = rng.standard_normal((4,) + OBS).astype(np.float32)
+    with make_service(actor, params, flush_ms=5.0) as svc:
+        reqs = [svc.act_async("s", obs[t], reset=(t == 0)) for t in range(4)]
+        for r in reqs:
+            assert r.wait(30.0) and r.code == OK
+    want = reference_rollout(actor, params, obs)
+    for t in range(4):
+        np.testing.assert_array_equal(reqs[t].action, want[t])
+
+
+def test_health_snapshot_counts_and_occupancy():
+    actor = make_actor()
+    with make_service(actor, params_step=42) as svc:
+        n = 6
+        pending = [
+            svc.act_async(f"s{i}", np.zeros(OBS, np.float32), reset=True)
+            for i in range(n)
+        ]
+        for r in pending:
+            assert r.wait(30.0) and r.code == OK
+        h = svc.health()
+    assert h.requests_ok == n
+    assert h.params_step == 42
+    assert h.sessions_active == n
+    assert 0.0 < h.batch_occupancy <= 1.0
+    assert h.latency_p99_ms >= h.latency_p50_ms >= 0.0
+    scalars = h.as_scalars()
+    assert "last_reload_error" not in scalars
+    assert all(isinstance(v, float) for v in scalars.values())
+
+
+def test_worker_survives_a_poison_batch():
+    """A batch that blows up inside the worker (injected device-step
+    failure — the stand-in for a transient XLA error) must fail THOSE
+    requests with internal_error and keep the service alive — a dead
+    worker would turn every later act() into a silent hang."""
+    from r2d2dpg_tpu.serving import INTERNAL_ERROR
+
+    actor = make_actor()
+    params = init_params(actor)
+    svc = make_service(actor, params, bucket_sizes=(2,), flush_ms=50.0)
+    real_step = svc._step
+
+    def boom(*a, **k):
+        raise RuntimeError("injected device failure")
+
+    with svc:
+        svc._step = boom
+        poisoned = [
+            svc.act_async("a", np.zeros(OBS, np.float32), reset=True),
+            svc.act_async("b", np.zeros(OBS, np.float32), reset=True),
+        ]
+        for r in poisoned:
+            assert r.wait(30.0)
+            assert r.code == INTERNAL_ERROR and r.action is None
+        # Service still serves once the fault clears; carries were rebuilt.
+        svc._step = real_step
+        ok = svc.act("a", np.zeros(OBS, np.float32), reset=True)
+        assert ok.code == OK
+        h = svc.health()
+    assert h.worker_errors == 1
+    assert "RuntimeError" in (h.last_worker_error or "")
+    assert h.requests_ok == 1
+    assert "worker_errors" in h.as_scalars()
+    assert "last_worker_error" not in h.as_scalars()
+
+
+def test_housekeeping_failure_is_contained_without_dropping_sessions():
+    """A failing health logger (e.g. full disk) must be noted in health and
+    NOT trigger the slab-rebuild recovery — session carries survive."""
+
+    class BoomLogger:
+        def log(self, step, scalars):
+            raise OSError("disk full")
+
+    actor = make_actor()
+    params = init_params(actor)
+    rng = np.random.default_rng(3)
+    obs = rng.standard_normal((3,) + OBS).astype(np.float32)
+    svc = make_service(actor, params, logger=BoomLogger(), log_every_s=0.0)
+    got = []
+    with svc:
+        for t in range(3):
+            res = svc.act("a", obs[t], reset=(t == 0))
+            assert res.code == OK
+            got.append(res.action)
+        h = svc.health()
+    assert h.worker_errors > 0 and "OSError" in h.last_worker_error
+    assert h.sessions_active == 1  # never cleared by the logger failures
+    want = reference_rollout(actor, params, obs)
+    for t in range(3):  # carry continuity across the failing housekeeping
+        np.testing.assert_array_equal(got[t], want[t])
+
+
+def test_ragged_obs_without_configured_shape_fails_only_that_request():
+    """obs_shape=None skips enqueue-time validation, so the worker screens
+    shapes per batch: the odd one out gets bad_request; everyone else's
+    carries and requests survive untouched."""
+    actor = make_actor()
+    params = init_params(actor)
+    svc = PolicyService(
+        actor, params, obs_shape=None, max_sessions=8,
+        bucket_sizes=(2,), flush_ms=50.0,
+    )
+    with svc:
+        good = svc.act_async("a", np.zeros(OBS, np.float32), reset=True)
+        bad = svc.act_async("b", np.zeros((7,), np.float32), reset=True)
+        assert good.wait(30.0) and bad.wait(30.0)
+        assert good.code == OK
+        assert bad.code == BAD_REQUEST
+        h = svc.health()
+    assert h.worker_errors == 0 and h.requests_ok == 1
+
+
+def test_many_threads_hammering_is_safe_and_accounted():
+    """Concurrency smoke: producers from many threads, bounded queue, every
+    request gets exactly one terminal code."""
+    actor = make_actor()
+    params = init_params(actor)
+    results = []
+    lock = threading.Lock()
+
+    with make_service(
+        actor, params, max_queue=8, max_sessions=8, bucket_sizes=(1, 2, 4)
+    ) as svc:
+
+        def client(i):
+            res = svc.act(f"s{i % 8}", np.zeros(OBS, np.float32), timeout=30.0)
+            with lock:
+                results.append(res.code)
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        h = svc.health()
+    assert len(results) == 32
+    assert set(results) <= {OK, SHED_QUEUE}
+    assert h.requests_ok == results.count(OK)
+    assert h.requests_shed == results.count(SHED_QUEUE)
