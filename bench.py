@@ -52,8 +52,10 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 METRIC = "learner_steps_per_sec_per_chip"
 # First TPU compile of the chunked learner scan is slow (~1-2 min on a cold
 # cache); give the child plenty, but keep it finite so a hung tunnel cannot
-# eat the driver's whole budget.
-CHILD_TIMEOUT_S = 420
+# eat the driver's whole budget.  Includes the pipelined-executor probe
+# (~1-2 min: two small train schedules + their compiles) riding in the
+# same child.
+CHILD_TIMEOUT_S = 540
 # Backend init on a live tunnel takes seconds; a dead tunnel hangs forever.
 INIT_DEADLINE_S = 150
 TPU_TRIES = 3
@@ -65,7 +67,13 @@ TPU_TRIES = 3
 SETTLE_S = (75, 240)
 
 
-def _emit(value: float, vs: float, backend: str, error: str | None = None) -> None:
+def _emit(
+    value: float,
+    vs: float,
+    backend: str,
+    error: str | None = None,
+    extra: dict | None = None,
+) -> None:
     rec = {
         "metric": METRIC,
         "value": round(value, 2),
@@ -75,13 +83,18 @@ def _emit(value: float, vs: float, backend: str, error: str | None = None) -> No
         # ADVICE r5 #2: the recorded baseline predates the donate_argnums
         # harness and the n-step 5 -> 3 recipe flip (seq 45 -> 43), so the
         # ratio is not a pure same-workload speedup until the baseline is
-        # re-recorded on TPU.
+        # re-recorded on TPU.  The pipelined-executor probe (the "pipeline"
+        # key) measures a SCHEDULE change — collect/learn overlapped over a
+        # staging queue vs phase-locked — not a same-schedule speedup.
         "vs_baseline_note": (
-            "baseline predates donate_argnums harness + n-step 3 recipe"
+            "baseline predates donate_argnums harness + n-step 3 recipe; "
+            "pipeline probe compares overlapped vs phase-locked schedule"
         ),
     }
     if error:
         rec["error"] = error[-400:]
+    if extra:
+        rec.update(extra)
     print(json.dumps(rec))
 
 
@@ -301,6 +314,114 @@ def main() -> None:
         _rearm_automation()
 
 
+def _pipeline_probe(backend: str) -> dict:
+    """Pipelined vs phase-locked executor throughput on the host-env config.
+
+    Walker-walk through the host pool (the config whose MuJoCo steps the
+    pipelined executor hides under learner compute), at reduced probe
+    shapes so the probe stays ~1 min on CPU: E=8 envs, stride 10, K=2
+    updates/phase, batch 32, hidden 128, seq 11.  Reports learner steps/s
+    under both schedules plus the executor's overlap fraction and
+    learner-wait p50/p99.  Never raises: on any failure (e.g. dm_control
+    cannot construct — broken EGL) it falls back to the pure-JAX pendulum
+    env so the schedule comparison still lands, and stamps the error.
+    """
+    import jax
+
+    def measure(env_factory, env_name: str) -> dict:
+        from r2d2dpg_tpu.agents.ddpg import AgentConfig, R2D2DPG
+        from r2d2dpg_tpu.models import ActorNet, CriticNet
+        from r2d2dpg_tpu.training.pipeline import (
+            PipelineConfig,
+            PipelineExecutor,
+        )
+        from r2d2dpg_tpu.training.trainer import Trainer, TrainerConfig
+
+        tcfg = TrainerConfig(
+            num_envs=8,
+            stride=10,
+            learner_steps=2,
+            batch_size=32,
+            capacity=4096,
+            min_replay=32,
+            prioritized=True,
+        )
+
+        def prep():
+            # A FRESH env + trainer per schedule leg: host pools are
+            # stateful, so reusing one env would leave the second leg's
+            # device state desynchronized from physics the first leg
+            # advanced.  Same seeds -> identical starting states.
+            env = env_factory()
+            acfg = AgentConfig(burnin=5, unroll=5, n_step=1)
+            actor = ActorNet(
+                action_dim=env.spec.action_dim, hidden=128, use_lstm=True
+            )
+            critic = CriticNet(hidden=128, use_lstm=True)
+            trainer = Trainer(env, R2D2DPG(actor, critic, acfg), tcfg)
+            state = trainer.init()
+            for _ in range(trainer.window_fill_phases):
+                state = trainer.collect_phase(state)
+            for _ in range(trainer.replay_fill_phases):
+                state = trainer.fill_phase(state)
+            return trainer, state
+
+        n = 6
+        trainer, state = prep()
+        ex_off = PipelineExecutor(trainer, PipelineConfig(enabled=False))
+        state = ex_off.run_train_phases(state, 1)  # compile, untimed
+        jax.block_until_ready(state.train.step)
+        t0 = time.perf_counter()
+        state = ex_off.run_train_phases(state, n)
+        jax.block_until_ready(state.train.step)
+        dt_off = time.perf_counter() - t0
+
+        trainer_on, state_on = prep()
+        ex_on = PipelineExecutor(trainer_on, PipelineConfig(enabled=True))
+        state_on = ex_on.run_train_phases(state_on, 1)  # compile, untimed
+        jax.block_until_ready(state_on.train.step)
+        state_on = ex_on.run_train_phases(state_on, n)
+        stats = ex_on.stats()
+
+        locked = n * tcfg.learner_steps / dt_off
+        piped = stats["learner_steps_per_sec"]
+        return {
+            "config": f"{env_name} E8 stride10 K2 b32 h128 seq11",
+            "backend": backend,
+            "phase_locked_learner_steps_per_sec": round(locked, 2),
+            "pipelined_learner_steps_per_sec": round(piped, 2),
+            "speedup": round(piped / max(locked, 1e-9), 3),
+            "overlap_fraction": round(stats["overlap_fraction"], 3),
+            "learner_wait_p50_ms": round(stats["learner_wait_p50_ms"], 2),
+            "learner_wait_p99_ms": round(stats["learner_wait_p99_ms"], 2),
+            "collect_wait_p50_ms": round(stats["collect_wait_p50_ms"], 2),
+            "collect_wait_p99_ms": round(stats["collect_wait_p99_ms"], 2),
+        }
+
+    out: dict = {}
+    try:
+        # The fallback wraps the WHOLE measurement, not just env
+        # construction: dm_control failures can first surface inside the
+        # pool's first reset (trainer.init) or mid-step.
+        try:
+            from r2d2dpg_tpu.envs.dmc_host import DMCHostEnv
+
+            out.update(
+                measure(
+                    lambda: DMCHostEnv("walker", "walk", action_repeat=2),
+                    "walker-walk(host-pool)",
+                )
+            )
+        except Exception as e:
+            from r2d2dpg_tpu.envs.pendulum import Pendulum
+
+            out["env_fallback"] = f"{type(e).__name__}: {e}"[-200:]
+            out.update(measure(Pendulum, "pendulum(fallback)"))
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[-300:]
+    return out
+
+
 def worker() -> None:
     """Measurement body — runs in a child with the backend already pinned."""
     import jax
@@ -405,7 +526,13 @@ def worker() -> None:
 
     baseline = _baseline()
     vs = steps_per_sec / baseline if baseline else 1.0
-    _emit(steps_per_sec, vs, backend)
+    # Pipelined-executor probe (ISSUE 2): rides in the same record under
+    # the "pipeline" key so the driver's one-JSON-line contract holds.
+    # R2D2DPG_BENCH_PIPELINE=0 skips it (e.g. time-critical TPU windows).
+    extra = None
+    if os.environ.get("R2D2DPG_BENCH_PIPELINE", "1") != "0":
+        extra = {"pipeline": _pipeline_probe(backend)}
+    _emit(steps_per_sec, vs, backend, extra=extra)
 
 
 if __name__ == "__main__":

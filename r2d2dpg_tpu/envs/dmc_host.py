@@ -37,6 +37,7 @@ from __future__ import annotations
 import atexit
 import dataclasses
 import functools
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Tuple
 
@@ -81,6 +82,11 @@ class _HostPool:
         self.executor: Optional[ThreadPoolExecutor] = None
         self.render_threads: list = []
         self._atexit_registered = False
+        # Host env state is mutable: with the pipelined executor the pool is
+        # driven from a collector thread (directly, or via the io_callback
+        # thread the collect program's ordered callback runs on) while other
+        # code may still reach it — serialize whole-fleet transitions.
+        self._step_lock = threading.Lock()
 
     def ensure(self, seeds: np.ndarray):
         """Create or re-seed the fleet to match the per-env ``seeds``."""
@@ -162,20 +168,25 @@ class _HostPool:
         return np.stack([_flatten_obs(ts.observation) for ts in dm_steps])
 
     def reset_all(self, seeds: np.ndarray):
-        self.ensure(seeds)
-        dm_steps = [env.reset() for env in self.envs]
-        obs = self._obs_all(dm_steps)
-        e = len(self.envs)
-        return (
-            obs,
-            np.zeros((e,), np.float32),
-            np.ones((e,), np.float32),
-            np.ones((e,), np.float32),
-        )
+        with self._step_lock:
+            self.ensure(seeds)
+            dm_steps = [env.reset() for env in self.envs]
+            obs = self._obs_all(dm_steps)
+            e = len(self.envs)
+            return (
+                obs,
+                np.zeros((e,), np.float32),
+                np.ones((e,), np.float32),
+                np.ones((e,), np.float32),
+            )
 
     def step_all(self, actions: np.ndarray, repeat: int = 1):
         if repeat < 1:
             raise ValueError(f"repeat must be >= 1, got {repeat}")
+        with self._step_lock:
+            return self._step_all_locked(actions, repeat)
+
+    def _step_all_locked(self, actions: np.ndarray, repeat: int):
 
         def step_one(i):
             env = self.envs[i]

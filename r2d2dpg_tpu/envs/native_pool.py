@@ -150,6 +150,10 @@ class NativeEnvPool:
         self._lib = load_library()
         self._handle: Optional[int] = None
         self._num_envs = 0
+        # Same contract as _HostPool._step_lock: the C++ pool mutates E
+        # mjData in place, and the pipelined executor steps it from a
+        # collector thread — whole-fleet transitions are serialized.
+        self._step_lock = threading.Lock()
 
     # ------------------------------------------------------------- lifecycle
     def _create(self, seeds: np.ndarray) -> None:
@@ -189,46 +193,48 @@ class NativeEnvPool:
 
     # ------------------------------------------------------------ batch API
     def reset_all(self, seeds: np.ndarray):
-        seeds = np.asarray(seeds)
-        if self._handle is None or len(seeds) != self._num_envs:
-            self._create(seeds)
-        else:
-            seeds64 = np.ascontiguousarray(seeds, np.int64)
-            self._lib.envpool_seed(
-                self._handle,
-                seeds64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        with self._step_lock:
+            seeds = np.asarray(seeds)
+            if self._handle is None or len(seeds) != self._num_envs:
+                self._create(seeds)
+            else:
+                seeds64 = np.ascontiguousarray(seeds, np.int64)
+                self._lib.envpool_seed(
+                    self._handle,
+                    seeds64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                )
+            e = self._num_envs
+            obs = np.empty((e, self.obs_dim), np.float32)
+            reward = np.empty((e,), np.float32)
+            discount = np.empty((e,), np.float32)
+            reset = np.empty((e,), np.float32)
+            self._lib.envpool_reset_all(
+                self._handle, _fptr(obs), _fptr(reward), _fptr(discount), _fptr(reset)
             )
-        e = self._num_envs
-        obs = np.empty((e, self.obs_dim), np.float32)
-        reward = np.empty((e,), np.float32)
-        discount = np.empty((e,), np.float32)
-        reset = np.empty((e,), np.float32)
-        self._lib.envpool_reset_all(
-            self._handle, _fptr(obs), _fptr(reward), _fptr(discount), _fptr(reset)
-        )
-        return obs, reward, discount, reset
+            return obs, reward, discount, reset
 
     def step_all(self, actions: np.ndarray, repeat: int = 1):
         assert self._handle is not None, "reset_all must run first"
         if repeat < 1:
             raise ValueError(f"repeat must be >= 1, got {repeat}")
-        e = self._num_envs
-        actions = np.ascontiguousarray(actions, np.float32)
-        assert actions.shape == (e, self.action_dim), actions.shape
-        obs = np.empty((e, self.obs_dim), np.float32)
-        reward = np.empty((e,), np.float32)
-        discount = np.empty((e,), np.float32)
-        reset = np.empty((e,), np.float32)
-        self._lib.envpool_step(
-            self._handle,
-            _fptr(actions),
-            int(repeat),
-            _fptr(obs),
-            _fptr(reward),
-            _fptr(discount),
-            _fptr(reset),
-        )
-        return obs, reward, discount, reset
+        with self._step_lock:
+            e = self._num_envs
+            actions = np.ascontiguousarray(actions, np.float32)
+            assert actions.shape == (e, self.action_dim), actions.shape
+            obs = np.empty((e, self.obs_dim), np.float32)
+            reward = np.empty((e,), np.float32)
+            discount = np.empty((e,), np.float32)
+            reset = np.empty((e,), np.float32)
+            self._lib.envpool_step(
+                self._handle,
+                _fptr(actions),
+                int(repeat),
+                _fptr(obs),
+                _fptr(reward),
+                _fptr(discount),
+                _fptr(reset),
+            )
+            return obs, reward, discount, reset
 
     # ---------------------------------------------------------- test hooks
     def get_state(self, i: int) -> Tuple[np.ndarray, np.ndarray]:

@@ -44,6 +44,7 @@ from r2d2dpg_tpu.parallel.mesh import DP_AXIS
 from r2d2dpg_tpu.parallel.spmd import _state_spec
 from r2d2dpg_tpu.training.assembler import StepRecord, shift_in
 from r2d2dpg_tpu.training.trainer import Trainer, TrainerConfig, TrainerState
+from r2d2dpg_tpu.utils.profiling import annotate
 
 
 class HostSPMDTrainer(Trainer):
@@ -348,6 +349,66 @@ class HostSPMDTrainer(Trainer):
         )
         return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
 
+    def _stride_loop(
+        self, state, behavior, critic_params, keys, rng, on_step=None
+    ):
+        """THE host stride loop: per-step policy dispatch -> action fetch ->
+        optional ``on_step(t)`` hook -> batched MuJoCo step -> obs re-entry,
+        then one jitted absorb of the whole phase.
+
+        Shared by ``_host_collect`` (hook = the overlap_learner substep
+        dispatch) and the pipelined executor's collector thread
+        (training/pipeline.py: a ``CollectorState`` and no hook), so the
+        fleet stacking / episode bookkeeping cannot drift between the two
+        schedules — ``_act_step``/``_absorb`` touch only the env-side
+        fields both state pytrees share."""
+        obs, reset = state.obs, state.reset
+        a_carry, c_carry = state.actor_carry, state.critic_carry
+        noise_st = state.noise_state
+        obs_T, reset_T, act_T, a_car_T, c_car_T = [], [], [], [], []
+        rew_T, disc_T, done_T = [], [], []
+
+        for t in range(self.config.stride):
+            obs_T.append(obs)
+            reset_T.append(reset)
+            a_car_T.append(a_carry)
+            c_car_T.append(c_carry)
+            action, a_carry, c_carry, noise_st = self._act_step(
+                behavior, critic_params, obs, reset, a_carry, c_carry,
+                noise_st, keys, np.int32(t),
+            )
+            act_T.append(action)
+            action_np = self._fetch_fleet(action)
+            if on_step is not None:
+                on_step(t)
+            # ═══ the one host<->device boundary per collected step ═══
+            with annotate("hybrid/host_env_step"):
+                o, r, d, res = self.env.host_step(action_np)
+            rew_T.append(r)
+            disc_T.append(d)
+            done_T.append(res)
+            obs = self._put_fleet(o)
+            reset = self._put_fleet(res)
+
+        with annotate("hybrid/absorb"):
+            return self._absorb(
+                state,
+                tuple(obs_T),
+                tuple(reset_T),
+                tuple(act_T),
+                tuple(a_car_T),
+                tuple(c_car_T),
+                self._put_stack(np.stack(rew_T)),
+                self._put_stack(np.stack(disc_T)),
+                self._put_stack(np.stack(done_T)),
+                obs,
+                reset,
+                a_carry,
+                c_carry,
+                noise_st,
+                rng,
+            )
+
     def _host_collect(
         self, state: TrainerState, learn: bool = False
     ) -> Tuple[TrainerState, Optional[Dict[str, jnp.ndarray]]]:
@@ -377,54 +438,22 @@ class HostSPMDTrainer(Trainer):
         sub = 0
         metrics_acc = []
 
-        obs, reset = state.obs, state.reset
-        a_carry, c_carry = state.actor_carry, state.critic_carry
-        noise_st = state.noise_state
-        obs_T, reset_T, act_T, a_car_T, c_car_T = [], [], [], [], []
-        rew_T, disc_T, done_T = [], [], []
-
-        for t in range(cfg.stride):
-            obs_T.append(obs)
-            reset_T.append(reset)
-            a_car_T.append(a_carry)
-            c_car_T.append(c_carry)
-            action, a_carry, c_carry, noise_st = self._act_step(
-                behavior, critic_params, obs, reset, a_carry, c_carry,
-                noise_st, keys, np.int32(t),
-            )
-            act_T.append(action)
-            action_np = self._fetch_fleet(action)
+        def dispatch_substeps(t: int) -> None:
             # Dispatch this step's share of learner updates AFTER the action
             # crossed to host (so act_step never waits behind an update) and
             # BEFORE the physics step (so the update runs under it).
+            nonlocal train, arena, sub
             while sub < n_sub and (sub + 1) * cfg.stride <= (t + 1) * n_sub:
-                train, arena, m = self._learn_substep(train, arena, lkeys[sub])
+                with annotate("hybrid/learn_substep"):
+                    train, arena, m = self._learn_substep(
+                        train, arena, lkeys[sub]
+                    )
                 metrics_acc.append(m)
                 sub += 1
-            # ═══ the one host<->device boundary per collected step ═══
-            o, r, d, res = self.env.host_step(action_np)
-            rew_T.append(r)
-            disc_T.append(d)
-            done_T.append(res)
-            obs = self._put_fleet(o)
-            reset = self._put_fleet(res)
 
-        state = self._absorb(
-            state,
-            tuple(obs_T),
-            tuple(reset_T),
-            tuple(act_T),
-            tuple(a_car_T),
-            tuple(c_car_T),
-            self._put_stack(np.stack(rew_T)),
-            self._put_stack(np.stack(disc_T)),
-            self._put_stack(np.stack(done_T)),
-            obs,
-            reset,
-            a_carry,
-            c_carry,
-            noise_st,
-            rng,
+        state = self._stride_loop(
+            state, behavior, critic_params, keys, rng,
+            on_step=dispatch_substeps if n_sub else None,
         )
         if not learn:
             return state, None
@@ -451,6 +480,8 @@ class HostSPMDTrainer(Trainer):
         # Behavior-snapshot persistence happens inside _collect_setup (jit).
         if not self.config.overlap_learner:
             state, _ = self._host_collect(state)
-            return self._emit_learn(state)
+            with annotate("hybrid/emit_learn"):
+                return self._emit_learn(state)
         state, metrics = self._host_collect(state, learn=True)
-        return self._emit_only(state), metrics
+        with annotate("hybrid/emit_add"):
+            return self._emit_only(state), metrics

@@ -5,6 +5,13 @@ from r2d2dpg_tpu.replay.arena import (
     ReplayArena,
     SampleResult,
     SequenceBatch,
+    StagedSequences,
 )
 
-__all__ = ["ArenaState", "ReplayArena", "SampleResult", "SequenceBatch"]
+__all__ = [
+    "ArenaState",
+    "ReplayArena",
+    "SampleResult",
+    "SequenceBatch",
+    "StagedSequences",
+]

@@ -72,6 +72,25 @@ class SampleResult:
     probs: jnp.ndarray  # [B] sampling probabilities (1/N for uniform)
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class StagedSequences:
+    """B emitted sequences in flight from a collector to the learner.
+
+    The pipelined executor's staging-queue payload (training/pipeline.py):
+    one pytree so a whole collect phase's emission crosses the queue as a
+    single object and enters the learner's drain program as one argument.
+    ``priorities`` is ``None`` when the learner computes the initial
+    priority at drain time (the default — it ranks fresh sequences with
+    its CURRENT nets, the same staleness class as the phase-locked path);
+    a collector that computes priorities locally (Ape-X style, with its
+    stale behavior nets) fills it instead.
+    """
+
+    seq: SequenceBatch  # leaves [B, L, ...] / carries [B, ...]
+    priorities: Any  # [B] float32, or None (learner-computed at drain)
+
+
 class ReplayArena:
     """Static replay configuration + pure state-transition functions.
 
@@ -131,6 +150,19 @@ class ReplayArena:
             cursor=(state.cursor + b) % self.capacity,
             total_added=state.total_added + b,
         )
+
+    def add_staged(self, state: ArenaState, staged: StagedSequences) -> ArenaState:
+        """Absorb a staged batch (the pipelined executor's drain path).
+
+        ``staged.priorities`` must be resolved by the caller (the drain
+        program fills ``None`` via ``Trainer._initial_priorities`` before
+        calling) — the arena itself has no nets to rank with."""
+        if staged.priorities is None:
+            raise ValueError(
+                "add_staged needs resolved priorities; compute them "
+                "(e.g. Trainer._initial_priorities) before absorbing"
+            )
+        return self.add(state, staged.seq, staged.priorities)
 
     # ------------------------------------------------------------------ size
     def size(self, state: ArenaState) -> jnp.ndarray:

@@ -48,6 +48,16 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="host-pool trainers: interleave learner updates between env "
         "steps so they hide under the MuJoCo step (1 = on)"
     )
+    p.add_argument(
+        "--pipeline", type=int, default=0, choices=[0, 1],
+        help="run train phases through the pipelined collect/learn "
+        "executor (training/pipeline.py): collection and learning overlap "
+        "in two threads over a bounded staging queue (1 = on)"
+    )
+    p.add_argument(
+        "--pipeline-depth", type=int, default=2,
+        help="staging-queue capacity in collect phases (backpressure bound)"
+    )
     # Agent/exploration hyperparameter overrides (VERDICT r2 weak #3: probe
     # whether the walker plateau is data-bound or hparam-capped).
     p.add_argument("--sigma-max", type=float, default=None,
@@ -151,6 +161,15 @@ def run(args) -> dict:
     if args.nan_debug:
         nan_debug(True)
 
+    if args.pipeline and (args.resume or args.eval_every or args.profile_phases):
+        # The pipelined executor owns the phase loop; the per-phase
+        # subsystems of the phase-locked loop below don't compose with it
+        # yet — refuse rather than silently skip (docs/PIPELINE.md).
+        raise SystemExit(
+            "--pipeline 1 does not support --resume/--eval-every/"
+            "--profile-phases yet"
+        )
+
     cfg = _apply_overrides(get_config(args.config), args)
 
     if args.spmd:
@@ -199,6 +218,9 @@ def run(args) -> dict:
     else:
         state = trainer.init()
 
+    if args.pipeline:
+        return _run_pipelined(trainer, state, logger, ckpt, args)
+
     warm = trainer.window_fill_phases
     fill = warm + trainer.replay_fill_phases
     eval_key = jax.random.PRNGKey(cfg.trainer.seed + 1)
@@ -245,13 +267,18 @@ def run(args) -> dict:
             if args.log_every and phase % args.log_every == 0:
                 state, ep = trainer.pop_episode_metrics(state)
                 scalars = dict(ep)
+                # ONE batched fetch for learn metrics + the step counter
+                # (per-scalar float() casts were N+1 blocking host syncs).
+                learn_np, lstep = jax.device_get(
+                    (last_learn, state.train.step)
+                )
                 scalars.update(
-                    {k: float(v) for k, v in last_learn.items()}
+                    {k: float(v) for k, v in learn_np.items()}
                 )
                 scalars.update(
                     logger.rates(
                         env_steps=ep["env_steps"],
-                        learner_steps=float(state.train.step),
+                        learner_steps=float(lstep),
                     )
                 )
                 logger.log(phase, scalars)
@@ -278,6 +305,77 @@ def run(args) -> dict:
         if ckpt is not None:
             if ckpt.save_every:
                 ckpt.save_final(phase, state)
+            ckpt.wait()
+            ckpt.close()
+        logger.close()
+    return final
+
+
+def _run_pipelined(trainer, state, logger, ckpt, args) -> dict:
+    """Drive the run through the pipelined executor (--pipeline 1).
+
+    The executor owns the warm-up -> fill -> train schedule and the log
+    cadence; metrics land in the same MetricLogger (CSV/TB) rows as the
+    phase-locked loop, and a final checkpoint is saved when a checkpoint
+    dir is configured."""
+    from r2d2dpg_tpu.training.pipeline import PipelineConfig, PipelineExecutor
+
+    executor = PipelineExecutor(
+        trainer,
+        PipelineConfig(enabled=True, queue_depth=args.pipeline_depth),
+    )
+    if ckpt is not None and ckpt.save_every and ckpt.save_every > 0:
+        # The state is split across two threads mid-run, so periodic saves
+        # aren't composed with the executor yet — degrade LOUDLY to the
+        # --checkpoint-every -1 (final-save-only) semantics.
+        print(
+            "pipeline: periodic checkpoints not supported with --pipeline 1; "
+            "saving the final checkpoint only (--checkpoint-every -1 "
+            "semantics)",
+            flush=True,
+        )
+    fill = trainer.window_fill_phases + trainer.replay_fill_phases
+    if args.phases is not None:
+        num_phases = fill + args.phases
+    elif args.minutes is not None:
+        num_phases = 10**9  # the wall-clock budget is the stop condition
+    else:
+        num_phases = fill + 1  # nothing requested: single-train-phase smoke
+
+    final: dict = {}
+
+    def metrics_fn(phase: int, scalars) -> None:
+        scalars = dict(scalars)
+        scalars.update(
+            logger.rates(
+                env_steps=scalars.get("env_steps", 0.0),
+                learner_steps=scalars.get("learner_steps", 0.0),
+            )
+        )
+        logger.log(phase, scalars)
+        final.clear()
+        final.update(scalars)
+
+    try:
+        state = executor.run(
+            num_phases,
+            state=state,
+            log_every=args.log_every,
+            metrics_fn=metrics_fn,
+            minutes=args.minutes,
+        )
+        stats = executor.stats()
+        if stats:
+            print(
+                "pipeline: "
+                + " ".join(f"{k} {v:.4g}" for k, v in sorted(stats.items())),
+                flush=True,
+            )
+            final.update({f"pipeline_{k}": v for k, v in stats.items()})
+        if ckpt is not None and ckpt.save_every:
+            ckpt.save_final(int(state.phase_idx), state)
+    finally:
+        if ckpt is not None:
             ckpt.wait()
             ckpt.close()
         logger.close()

@@ -1,16 +1,31 @@
-"""Training orchestration (SURVEY.md §2.5): the Anakin phase loop."""
+"""Training orchestration (SURVEY.md §2.5): the Anakin phase loop, plus the
+pipelined collect/learn executor that overlaps the two (training/pipeline.py)."""
 
 from r2d2dpg_tpu.training.assembler import StepRecord, emit, init_window, shift_in
 from r2d2dpg_tpu.training.evaluator import Evaluator
+from r2d2dpg_tpu.training.pipeline import (
+    CollectorState,
+    LearnerState,
+    PipelineConfig,
+    PipelineExecutor,
+    merge_state,
+    split_state,
+)
 from r2d2dpg_tpu.training.trainer import Trainer, TrainerConfig, TrainerState
 
 __all__ = [
+    "CollectorState",
     "Evaluator",
+    "LearnerState",
+    "PipelineConfig",
+    "PipelineExecutor",
     "StepRecord",
     "Trainer",
     "TrainerConfig",
     "TrainerState",
     "emit",
     "init_window",
+    "merge_state",
     "shift_in",
+    "split_state",
 ]

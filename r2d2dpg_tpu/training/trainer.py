@@ -39,6 +39,7 @@ from r2d2dpg_tpu.envs.core import Environment
 from r2d2dpg_tpu.ops import anneal_beta, gaussian_noise, importance_weights, ou_step, sigma_ladder
 from r2d2dpg_tpu.replay.arena import ArenaState, ReplayArena, SequenceBatch
 from r2d2dpg_tpu.training.assembler import StepRecord, emit, init_window, shift_in
+from r2d2dpg_tpu.utils.profiling import annotate, scope
 
 
 @dataclasses.dataclass(frozen=True)
@@ -257,17 +258,28 @@ class Trainer:
         )
         return action, a_carry, c_carry, noise_st
 
-    def _collect(self, state: TrainerState) -> Tuple[TrainerState, StepRecord]:
+    def _collect(
+        self, state: TrainerState, behavior=None, critic_params=None
+    ) -> TrainerState:
         """Scan ``stride`` vmapped env steps; returns time-major records.
 
         SURVEY §3.2's hot loop A, vectorized: policy forward (behavior
         params), exploration noise, env step, episode bookkeeping.  The
         critic also steps along so its recurrent state exists for storage
         (R2D2-DPG stores initial state for *both* nets' cores).
+
+        ``behavior``/``critic_params`` default to the state's own train
+        params (the phase-locked path).  The pipelined executor passes them
+        explicitly: its collector state carries no learner subtree, and the
+        snapshot must stay a non-donated program input so the learner's
+        published params outlive the donated collector state
+        (training/pipeline.py).
         """
         cfg = self.config
-        behavior = self._behavior_params(state)
-        critic_params = self.agent.behavior_critic_params(state.train)
+        if behavior is None:
+            behavior = self._behavior_params(state)
+        if critic_params is None:
+            critic_params = self.agent.behavior_critic_params(state.train)
         sigmas = self._local_sigmas()
         rng, scan_key = jax.random.split(state.rng)
         scan_key = self._fold_axis(scan_key)
@@ -339,34 +351,40 @@ class Trainer:
         )
         return state
 
+    def _initial_priorities(self, train, arena, seq) -> jnp.ndarray:
+        """Entry priority for B fresh sequences (SURVEY §2.2 initial priority).
+
+        Factored out of ``_emit_and_add`` so the pipelined executor's drain
+        program — which holds only the learner subtree, not a full
+        TrainerState — computes the same ranking the phase-locked path does."""
+        if self.config.initial_priority == "td" and self.config.prioritized:
+            return self.agent.initial_priority(train, seq)
+        if self.config.prioritized:
+            return jnp.full(
+                (self.config.num_envs,),
+                jnp.maximum(arena.priority.max(), 1.0),
+            )
+        return jnp.ones((self.config.num_envs,))
+
     def _emit_and_add(self, state: TrainerState) -> TrainerState:
         """Emit the window as one sequence per env and add with priority."""
         seq = emit(state.window)
-        if self.config.initial_priority == "td" and self.config.prioritized:
-            prios = self.agent.initial_priority(state.train, seq)
-        elif self.config.prioritized:
-            prios = jnp.full(
-                (self.config.num_envs,),
-                jnp.maximum(state.arena.priority.max(), 1.0),
-            )
-        else:
-            prios = jnp.ones((self.config.num_envs,))
+        prios = self._initial_priorities(state.train, state.arena, seq)
         seq, prios = self._reshard_add(seq, prios)
         arena = self.arena.add(state.arena, seq, prios)
         return dataclasses.replace(state, arena=arena)
 
-    def _learn_step(self, train, arena, key):
-        """ONE prioritized learner update: sample -> IS weights -> update ->
-        priority write-back.  Shared by the in-graph scan (``_learn``) and
-        the hybrid trainer's interleaved substep jit, so sampling/anneal/
-        write-back semantics cannot drift between the two paths."""
+    def _update_step(self, train, arena, res, key):
+        """The update half of one learner step: IS weights -> gradient
+        update -> priority write-back, on an already-sampled ``res``.
+        Split from ``_learn_step`` so the prefetched learn path can draw
+        batch k+1 before this step's write-back lands."""
         cfg = self.config
         # fold_in (not split) for the smoothing key: sampling keeps consuming
         # the substep key directly, so knobs-off runs draw the exact same
         # batch sequence as round 2 at a fixed seed (the folded key is DCE'd
         # from the graph when target_policy_sigma == 0).
         kl = jax.random.fold_in(key, 1)
-        res = self.arena.sample(arena, key, cfg.batch_size)
         if cfg.prioritized:
             beta = anneal_beta(train.step, beta0=cfg.beta0, steps=cfg.beta_steps)
             w = importance_weights(res.probs, self.arena.size(arena), beta=beta)
@@ -379,19 +397,63 @@ class Trainer:
             arena = self.arena.update_priorities(arena, res.indices, prios)
         return train, arena, metrics
 
+    def _learn_step(self, train, arena, key):
+        """ONE prioritized learner update: sample -> IS weights -> update ->
+        priority write-back.  Shared by the in-graph scan (``_learn``) and
+        the hybrid trainer's interleaved substep jit, so sampling/anneal/
+        write-back semantics cannot drift between the two paths."""
+        res = self.arena.sample(arena, key, self.config.batch_size)
+        return self._update_step(train, arena, res, key)
+
+    def _learn_many(
+        self, train, arena, key, *, prefetch: bool = False
+    ) -> Tuple[TrainState, ArenaState, Dict[str, jnp.ndarray]]:
+        """K learner updates on a bare (train, arena) pair.
+
+        The phase-locked ``_learn`` and the pipelined drain program
+        (training/pipeline.py) share this body so sampling/anneal/write-back
+        semantics cannot drift between the two schedules.
+
+        ``prefetch=True`` double-buffers the batch: batch k+1 is sampled
+        BEFORE update k's priority write-back lands, breaking the
+        sample->write-back->sample dependency chain so the gather for the
+        next batch overlaps the current update's compute.  Sampling then
+        sees priorities one update stale — pipelined mode only; the
+        phase-locked path keeps the exact sequential chain.
+        """
+        cfg = self.config
+        keys = jax.random.split(key, cfg.learner_steps)
+        if not prefetch:
+
+            def one(carry, key):
+                train, arena, metrics = self._learn_step(*carry, key)
+                return (train, arena), metrics
+
+            (train, arena), metrics = lax.scan(one, (train, arena), keys)
+        else:
+            # Batch k keeps its phase-locked sample key (keys[k]); only the
+            # priorities it is drawn against are one write-back stale.
+            res0 = self.arena.sample(arena, keys[0], cfg.batch_size)
+            next_keys = jnp.roll(keys, -1, axis=0)  # keys[k+1]; last unused
+
+            def one_prefetch(carry, ks):
+                train, arena, res = carry
+                key, next_key = ks
+                next_res = self.arena.sample(arena, next_key, cfg.batch_size)
+                train, arena, metrics = self._update_step(train, arena, res, key)
+                return (train, arena, next_res), metrics
+
+            (train, arena, _), metrics = lax.scan(
+                one_prefetch, (train, arena, res0), (keys, next_keys)
+            )
+        metrics = jax.tree_util.tree_map(lambda m: self._pmean(m.mean()), metrics)
+        return train, arena, metrics
+
     def _learn(self, state: TrainerState) -> Tuple[TrainerState, Dict[str, jnp.ndarray]]:
         """K learner updates: sample -> update -> priority write-back."""
-        cfg = self.config
         rng, key = jax.random.split(state.rng)
         key = self._fold_axis(key)
-
-        def one(carry, key):
-            train, arena, metrics = self._learn_step(*carry, key)
-            return (train, arena), metrics
-
-        keys = jax.random.split(key, cfg.learner_steps)
-        (train, arena), metrics = lax.scan(one, (state.train, state.arena), keys)
-        metrics = jax.tree_util.tree_map(lambda m: self._pmean(m.mean()), metrics)
+        train, arena, metrics = self._learn_many(state.train, state.arena, key)
         state = dataclasses.replace(state, train=train, arena=arena, rng=rng)
         return state, metrics
 
@@ -405,6 +467,8 @@ class Trainer:
     def _train_phase(
         self, state: TrainerState
     ) -> Tuple[TrainerState, Dict[str, jnp.ndarray]]:
+        # scope(): HLO-metadata names so the TB profiler timeline shows the
+        # collect/emit/learn stages of the fused phase (utils/profiling.py).
         if self.config.param_sync_every > 0:
             # Persist the snapshot *before* collecting (phase_idx is still
             # this phase's index), so the params _collect acts with are
@@ -412,8 +476,12 @@ class Trainer:
             state = dataclasses.replace(
                 state, behavior_params=self._behavior_params(state)
             )
-        state = self._emit_and_add(self._collect(state))
-        return self._learn(state)
+        with scope("collect"):
+            state = self._collect(state)
+        with scope("emit_add"):
+            state = self._emit_and_add(state)
+        with scope("learn"):
+            return self._learn(state)
 
     # ------------------------------------------------------------ schedule
     @property
@@ -429,13 +497,19 @@ class Trainer:
     def pop_episode_metrics(
         self, state: TrainerState
     ) -> Tuple[TrainerState, Dict[str, float]]:
-        """Host-side: drain the completed-episode accumulators (L6 logging)."""
-        count = float(state.completed_count)
-        mean_ret = float(state.completed_return_sum) / max(count, 1.0)
+        """Host-side: drain the completed-episode accumulators (L6 logging).
+
+        ONE batched ``jax.device_get`` for all three scalars — three
+        separate ``float(...)`` casts were three blocking host syncs per
+        log call.  Callers invoke this only on the log cadence."""
+        count, ret_sum, env_steps = jax.device_get(
+            (state.completed_count, state.completed_return_sum, state.env_steps)
+        )
+        count = float(count)
         metrics = {
-            "episode_return_mean": mean_ret,
+            "episode_return_mean": float(ret_sum) / max(count, 1.0),
             "episodes": count,
-            "env_steps": float(state.env_steps),
+            "env_steps": float(env_steps),
         }
         state = dataclasses.replace(
             state,
@@ -457,15 +531,25 @@ class Trainer:
         warm, fill = self.window_fill_phases, self.replay_fill_phases
         last_metrics: Dict[str, jnp.ndarray] = {}
         for phase in range(num_phases):
+            # annotate(): host-side trace regions around each phase dispatch
+            # so the TB profiler timeline separates the schedule stages.
             if phase < warm:
-                state = self.collect_phase(state)
+                with annotate("trainer/collect_phase"):
+                    state = self.collect_phase(state)
             elif phase < warm + fill:
-                state = self.fill_phase(state)
+                with annotate("trainer/fill_phase"):
+                    state = self.fill_phase(state)
             else:
-                state, last_metrics = self.train_phase(state)
+                with annotate("trainer/train_phase"):
+                    state, last_metrics = self.train_phase(state)
             if log_every and (phase + 1) % log_every == 0:
                 state, ep = self.pop_episode_metrics(state)
-                scalars = {k: float(v) for k, v in last_metrics.items()}
+                # One batched fetch for the learn metrics too (a float()
+                # per metric would be N more blocking host syncs).
+                scalars = {
+                    k: float(v)
+                    for k, v in jax.device_get(last_metrics).items()
+                }
                 log_fn(
                     f"phase {phase + 1}/{num_phases} "
                     f"env_steps {int(ep['env_steps'])} "

@@ -39,16 +39,27 @@ class PercentileWindow:
         self._buf: collections.deque = collections.deque(maxlen=size)
         self._lock = threading.Lock()
         self._count = 0
+        self._total = 0.0
 
     def add(self, value: float) -> None:
         with self._lock:
             self._buf.append(float(value))
             self._count += 1
+            self._total += float(value)
 
     @property
     def count(self) -> int:
         """Total observations ever added (not just those still windowed)."""
         return self._count
+
+    @property
+    def total(self) -> float:
+        """Running sum of ALL observations ever added (not windowed).
+
+        The pipelined executor derives its overlap fraction from total
+        stage-wait seconds over wall-clock; the window alone would forget
+        waits older than ``size`` observations."""
+        return self._total
 
     def percentiles(self, qs: Iterable[float] = (50.0, 99.0)) -> Tuple[float, ...]:
         """Nearest-rank percentiles over the current window (0.0 if empty)."""

@@ -41,6 +41,37 @@ def nan_debug(enable: bool = True) -> None:
 
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
-    """Name a region so it shows up in profiler timelines."""
+    """Name a HOST-side region so it shows up in profiler timelines.
+
+    Use around dispatch sites in driver loops (``Trainer.run``, the
+    pipelined executor's collect/learn threads, the hybrid trainer's host
+    loop): the annotation spans the host time of the block, which for
+    host-driven collect is the real work.  For regions *inside* jitted
+    code use ``scope`` instead — a TraceAnnotation under tracing would
+    only mark trace time, not device time."""
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+def scope(name: str):
+    """Name a region of TRACED code: ops inside the block carry ``name`` in
+    their HLO metadata, so the TB profiler timeline groups a fused phase's
+    collect/emit/learn stages.  Safe under jit (this is ``jax.named_scope``);
+    pairs with ``annotate`` which covers the host side."""
+    return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def timed(window) -> Iterator[None]:
+    """Time the enclosed block (seconds) into a ``PercentileWindow``.
+
+    The pipelined executor's per-stage wait instrumentation: wrap the
+    queue-blocking section of each stage and read p50/p99 plus the running
+    total off the window (``utils.metrics.PercentileWindow``)."""
+    import time
+
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        window.add(time.monotonic() - t0)

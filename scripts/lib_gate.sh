@@ -98,6 +98,12 @@ run_evidence() {
         touch "$dir/.train_complete"
       fi
     fi
+    # Pipelined runs (--pipeline 1): the executor owns the phase loop and
+    # rejects periodic eval (train.py guard), so mid-run eval curves are
+    # dropped for them — the blessing evidence is the FINAL 20-ep eval
+    # below either way, which still runs off the final checkpoint.
+    local evalevery=150
+    case " $* " in *" --pipeline 1 "*) evalevery=0 ;; esac
     if ! [ -f "$dir/.train_complete" ]; then
       echo "=== $dir attempt $attempt train start ($*) $(date) ==="
       rm -rf "$dir"
@@ -105,7 +111,7 @@ run_evidence() {
       nice -n 19 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
       python -m r2d2dpg_tpu.train "$@" \
         --seed "$seed" --minutes "$minutes" \
-        --log-every 10 --eval-every 150 --eval-envs 5 \
+        --log-every 10 --eval-every "$evalevery" --eval-envs 5 \
         --logdir "$dir" --checkpoint-dir "$dir/ckpt" --checkpoint-every 150 \
         > "$dir/stdout.log" 2>&1
       rc=$?
@@ -116,7 +122,13 @@ run_evidence() {
     fi
     if [ -f "$dir/.train_complete" ] \
        && [ -d "$dir/ckpt" ] && [ -n "$(ls "$dir/ckpt" 2>/dev/null)" ]; then
+      # Gate AFTER wait_on_box: the determinism pytest is itself a
+      # CPU-heavy step and must honor the single-core discipline.
       wait_on_box "$waitpat"
+      if ! pipeline_gate "$dir" "$@"; then
+        echo "$dir: pipeline determinism gate FAILED (attempt $attempt)"
+        continue
+      fi
       timeout --kill-after=30 --signal=TERM 1800 \
         env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
         python -m r2d2dpg_tpu.eval $evalflags \
@@ -127,6 +139,35 @@ run_evidence() {
         || echo "$dir eval FAILED (attempt $attempt)"
     fi
   done
+}
+
+# Pipelined evidence gate (ISSUE 2): a run dir trained with --pipeline 1
+# may only be blessed (.done) if the pipeline=off determinism test passes
+# on this checkout — proof the executor's schedule is still bit-faithful
+# to the phase-locked trainer before any pipelined number becomes
+# evidence (docs/PIPELINE.md "Determinism contract").  The verdict is
+# stamped per run dir so retries (and the eval-only path) don't re-pay
+# the ~2 min test; non-pipelined runs pass through untouched.
+#   pipeline_gate <dir> <train args...>
+pipeline_gate() {
+  local dir=$1
+  shift
+  case " $* " in
+    *" --pipeline 1 "*) ;;
+    *) return 0 ;;  # not a pipelined run: nothing to gate
+  esac
+  if [ -f "$dir/.pipeline_determinism_ok" ]; then
+    return 0
+  fi
+  if timeout --kill-after=30 900 \
+       env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+       python -m pytest tests/test_pipeline.py -q -p no:cacheprovider \
+         -k determinism \
+       > "$dir/pipeline_gate.log" 2>&1; then
+    touch "$dir/.pipeline_determinism_ok"
+    return 0
+  fi
+  return 1
 }
 
 gate_on_box() {
