@@ -1,7 +1,9 @@
 """``python -m r2d2dpg_tpu <cmd> ...`` — subcommand dispatch.
 
 ``train`` (the default, so the historical ``python -m r2d2dpg_tpu
---config ...`` spelling keeps working), ``eval``, and ``serve``.
+--config ...`` spelling keeps working), ``eval``, and ``serve``.  Both
+``train`` and ``serve`` take ``--obs-port`` to expose the process
+telemetry registry (docs/OBSERVABILITY.md).
 """
 
 import sys

@@ -507,6 +507,12 @@ class R2D2DPG:
             "q_mean": q_pred.mean(),
             "td_abs_mean": jnp.abs(td).mean(),
             "target_mean": y.mean(),
+            # Divergence-watchdog inputs (obs/watchdog.py): global norms of
+            # this step's gradients and the updated params, computed
+            # in-graph and fetched with the SAME batched device_get as the
+            # losses on the log cadence — no extra host syncs.
+            "grad_norm": optax.global_norm((actor_grads, critic_grads)),
+            "param_norm": optax.global_norm((actor_params, critic_params)),
         }
         if cfg.twin_critic:
             metrics["q_spread"] = q_spread  # |Q1-Q2|: overestimation proxy

@@ -38,6 +38,7 @@ import atexit
 import dataclasses
 import functools
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Tuple
 
@@ -47,6 +48,7 @@ import numpy as np
 from jax.experimental import io_callback
 
 from r2d2dpg_tpu.envs.core import EnvSpec, TimeStep
+from r2d2dpg_tpu.envs.native_pool import _pool_instruments
 
 _PIXEL_HW = 64
 
@@ -87,6 +89,9 @@ class _HostPool:
         # thread the collect program's ordered callback runs on) while other
         # code may still reach it — serialize whole-fleet transitions.
         self._step_lock = threading.Lock()
+        self._obs_step, self._obs_lock_wait, self._obs_resets = (
+            _pool_instruments("python")
+        )
 
     def ensure(self, seeds: np.ndarray):
         """Create or re-seed the fleet to match the per-env ``seeds``."""
@@ -183,8 +188,14 @@ class _HostPool:
     def step_all(self, actions: np.ndarray, repeat: int = 1):
         if repeat < 1:
             raise ValueError(f"repeat must be >= 1, got {repeat}")
+        t_lock = time.monotonic()
         with self._step_lock:
-            return self._step_all_locked(actions, repeat)
+            t0 = time.monotonic()
+            self._obs_lock_wait.add(t0 - t_lock)
+            out = self._step_all_locked(actions, repeat)
+            self._obs_step.add(time.monotonic() - t0)
+            self._obs_resets.inc(float(out[3].sum()))
+            return out
 
     def _step_all_locked(self, actions: np.ndarray, repeat: int):
 

@@ -20,9 +20,37 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
+
+from r2d2dpg_tpu.obs import get_registry
+
+
+def _pool_instruments(pool: str):
+    """The shared env-pool instrument set, bound to one ``pool`` label.
+
+    One metric family each for step latency, lock waits and resets —
+    ``pool="native"`` (C++ fleet) vs ``pool="python"`` (dm_control fleet)
+    distinguishes the implementations at scrape time."""
+    reg = get_registry()
+    step = reg.histogram(
+        "r2d2dpg_envpool_step_seconds",
+        "whole-fleet batched env step latency",
+        labelnames=("pool",),
+    ).labels(pool=pool)
+    lock = reg.histogram(
+        "r2d2dpg_envpool_lock_wait_seconds",
+        "wait to acquire the fleet step lock (cross-thread contention)",
+        labelnames=("pool",),
+    ).labels(pool=pool)
+    resets = reg.counter(
+        "r2d2dpg_envpool_resets_total",
+        "episode auto-resets across the fleet",
+        labelnames=("pool",),
+    ).labels(pool=pool)
+    return step, lock, resets
 
 # (domain, task) -> TaskId in native/envpool/env_pool.cc.
 NATIVE_TASKS = {
@@ -154,6 +182,9 @@ class NativeEnvPool:
         # mjData in place, and the pipelined executor steps it from a
         # collector thread — whole-fleet transitions are serialized.
         self._step_lock = threading.Lock()
+        self._obs_step, self._obs_lock_wait, self._obs_resets = (
+            _pool_instruments("native")
+        )
 
     # ------------------------------------------------------------- lifecycle
     def _create(self, seeds: np.ndarray) -> None:
@@ -217,7 +248,10 @@ class NativeEnvPool:
         assert self._handle is not None, "reset_all must run first"
         if repeat < 1:
             raise ValueError(f"repeat must be >= 1, got {repeat}")
+        t_lock = time.monotonic()
         with self._step_lock:
+            t0 = time.monotonic()
+            self._obs_lock_wait.add(t0 - t_lock)
             e = self._num_envs
             actions = np.ascontiguousarray(actions, np.float32)
             assert actions.shape == (e, self.action_dim), actions.shape
@@ -234,6 +268,8 @@ class NativeEnvPool:
                 _fptr(discount),
                 _fptr(reset),
             )
+            self._obs_step.add(time.monotonic() - t0)
+            self._obs_resets.inc(float(reset.sum()))
             return obs, reward, discount, reset
 
     # ---------------------------------------------------------- test hooks

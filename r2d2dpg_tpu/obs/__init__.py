@@ -1,0 +1,59 @@
+"""Unified telemetry (ISSUE 3): registry, exporter, flight recorder, watchdog.
+
+One process-wide namespace for every subsystem's operator signals:
+
+- ``registry``  — typed Counter/Gauge/Histogram instruments with label
+  sets (``get_registry()`` is the process singleton all subsystems
+  register into).
+- ``exporter``  — stdlib-HTTP scrape point (``/metrics`` Prometheus text,
+  ``/metrics.json`` snapshot) on ``--obs-port``.
+- ``flight``    — bounded ring of structured events dumped to
+  ``flight.jsonl`` on exit/abort (``flight_event(kind, **fields)``).
+- ``watchdog``  — NaN/Inf + grad/param-norm checks riding the log
+  cadence's existing batched ``device_get``; trips abort loudly.
+
+See docs/OBSERVABILITY.md for the naming scheme, endpoints, event schema
+and thresholds.
+"""
+
+from r2d2dpg_tpu.obs.exporter import (
+    MetricsExporter,
+    current_exporter,
+    start_exporter,
+    stop_exporter,
+)
+from r2d2dpg_tpu.obs.flight import (
+    FlightRecorder,
+    flight_event,
+    get_flight_recorder,
+)
+from r2d2dpg_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    get_registry,
+)
+from r2d2dpg_tpu.obs.watchdog import (
+    DivergenceError,
+    DivergenceWatchdog,
+    WatchdogConfig,
+)
+
+__all__ = [
+    "Counter",
+    "DivergenceError",
+    "DivergenceWatchdog",
+    "FlightRecorder",
+    "Gauge",
+    "Histogram",
+    "MetricsExporter",
+    "Registry",
+    "WatchdogConfig",
+    "current_exporter",
+    "flight_event",
+    "get_flight_recorder",
+    "get_registry",
+    "start_exporter",
+    "stop_exporter",
+]

@@ -1,0 +1,116 @@
+"""Pull exporter: a stdlib-HTTP background thread serving the registry.
+
+One scrape point per process (Ape-X operator visibility: queue depths and
+staleness are only actionable when something can *read* them while the run
+is live):
+
+- ``GET /metrics``        Prometheus text exposition (histograms as
+                          summaries) — point a Prometheus scraper or
+                          ``curl`` at it.
+- ``GET /metrics.json``   the registry's typed JSON snapshot.
+- ``GET /healthz``        ``ok`` (liveness only).
+
+No dependencies beyond ``http.server``; the server thread is a daemon so
+it never blocks process exit, and ``start_exporter`` is a process
+singleton — train and serve CLIs call it with ``--obs-port`` (0 = bind an
+ephemeral port; the resolved port is on ``exporter.port`` and printed by
+the CLIs).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from r2d2dpg_tpu.obs.registry import Registry, get_registry
+
+
+class MetricsExporter:
+    """Serve one registry over HTTP until ``stop()`` (or process exit)."""
+
+    def __init__(
+        self, registry: Registry, port: int = 0, host: str = "0.0.0.0"
+    ):
+        self.registry = registry
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                path = self.path.split("?")[0]
+                if path == "/metrics":
+                    body = exporter.registry.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path in ("/metrics.json", "/snapshot"):
+                    body = json.dumps(exporter.registry.snapshot()).encode()
+                    ctype = "application/json"
+                elif path == "/healthz":
+                    body, ctype = b"ok\n", "text/plain"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr spam
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="obs-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+
+_lock = threading.Lock()
+_exporter: Optional[MetricsExporter] = None
+
+
+def start_exporter(
+    port: int = 0,
+    registry: Optional[Registry] = None,
+    host: str = "0.0.0.0",
+) -> MetricsExporter:
+    """Start (or return) THE process exporter.
+
+    A second call while one is running returns the existing exporter —
+    one process, one scrape point — regardless of the requested
+    port/host.  ``host`` defaults to all interfaces (a scrape endpoint
+    exists to be scraped); pass ``127.0.0.1`` (``--obs-host``) to keep it
+    loopback-only on shared hosts."""
+    global _exporter
+    with _lock:
+        if _exporter is None:
+            _exporter = MetricsExporter(
+                registry if registry is not None else get_registry(),
+                port,
+                host,
+            )
+        return _exporter
+
+
+def stop_exporter() -> None:
+    """Tear the singleton down (tests)."""
+    global _exporter
+    with _lock:
+        if _exporter is not None:
+            _exporter.stop()
+            _exporter = None
+
+
+def current_exporter() -> Optional[MetricsExporter]:
+    with _lock:
+        return _exporter

@@ -1,0 +1,134 @@
+"""Flight recorder: a bounded ring of structured events for post-mortems.
+
+Queue stalls, param publishes, hot-reloads, TTL evictions, shed codes,
+checkpoint saves, watchdog trips — each subsystem drops a small structured
+event into a process-wide ring (``flight_event(kind, **fields)``).  The
+ring is bounded (old events fall off), recording is a deque append under a
+lock (~µs, safe on hot-ish paths), and nothing is written to disk until a
+**dump** — on normal exit (atexit), on a watchdog abort, or on demand.
+
+Dumps are JSONL (one event per line, oldest first) written atomically
+(tmp + rename) so a crash mid-dump never leaves a torn file.  Each event
+carries::
+
+    {"kind": ..., "t_wall": <unix seconds>, "t_mono": <monotonic seconds>,
+     "seq": <monotone index>, "thread": <recording thread name>, ...fields}
+
+Hard crashes (SIGSEGV & friends) cannot run Python: ``install()`` also
+points ``faulthandler`` at a sidecar ``<path>.fault`` file so native
+tracebacks land next to the last dumped ring.
+"""
+
+from __future__ import annotations
+
+import atexit
+import faulthandler
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class FlightRecorder:
+    """Bounded in-memory event ring + JSONL dump."""
+
+    def __init__(self, capacity: int = 512):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self._installed_path: Optional[str] = None
+        self._fault_file = None
+
+    # ---------------------------------------------------------------- record
+    def record(self, kind: str, **fields) -> None:
+        event = {
+            "kind": str(kind),
+            "t_wall": time.time(),
+            "t_mono": time.monotonic(),
+            "thread": threading.current_thread().name,
+        }
+        event.update(fields)
+        with self._lock:
+            event["seq"] = self._seq
+            self._seq += 1
+            self._ring.append(event)
+
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._ring)
+
+    @property
+    def recorded_total(self) -> int:
+        """Events ever recorded (≥ len(events()) once the ring wrapped)."""
+        with self._lock:
+            return self._seq
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # ------------------------------------------------------------------ dump
+    def dump(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the ring as JSONL (atomic tmp+rename).  Returns the path,
+        or None when neither ``path`` nor an installed path exists."""
+        path = path or self._installed_path
+        if path is None:
+            return None
+        events = self.events()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            for e in events:
+                f.write(json.dumps(e, default=str) + "\n")
+        os.replace(tmp, path)
+        return path
+
+    # --------------------------------------------------------------- install
+    def install(self, path: str) -> None:
+        """Arm exit-time capture: dump to ``path`` at interpreter exit and
+        route hard-crash native tracebacks to ``<path>.fault``.
+
+        Idempotent per path; re-installing with a new path re-targets the
+        dump (one atexit hook either way).  Watchdog/abort paths call
+        ``dump()`` explicitly — atexit is the safety net, not the contract.
+        """
+        with self._lock:
+            first = self._installed_path is None
+            self._installed_path = path
+        if first:
+            atexit.register(self._atexit_dump)
+        # faulthandler can't run Python on SIGSEGV; give it a sidecar file
+        # so the native traceback survives next to the last dump.
+        try:
+            fault = open(f"{path}.fault", "w")
+            faulthandler.enable(file=fault)
+            old, self._fault_file = self._fault_file, fault
+            if old is not None:
+                old.close()
+        except OSError:
+            pass  # unwritable dir: the ring (and atexit dump) still work
+
+    def _atexit_dump(self) -> None:
+        try:
+            self.dump()
+        except OSError:
+            pass  # exit-time best effort: never turn teardown into a crash
+
+
+_RECORDER = FlightRecorder()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """THE process-wide flight recorder (module singleton)."""
+    return _RECORDER
+
+
+def flight_event(kind: str, **fields) -> None:
+    """Record one event into the process recorder (the library-side API)."""
+    _RECORDER.record(kind, **fields)

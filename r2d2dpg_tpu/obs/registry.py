@@ -1,0 +1,409 @@
+"""Typed instrument registry: the process-wide telemetry namespace.
+
+Every concurrent subsystem in this repo (phase-locked / pipelined training,
+host env pools, the replay arena, policy serving) registers its operator
+signals here as typed instruments, so one scrape point — the exporter
+(``obs/exporter.py``) or the MetricLogger CSV/TB bridge — sees them all.
+The Podracer line treats throughput accounting as a design input: a stage
+must be *attributable* before it can be optimized, and attribution starts
+with a single namespace.
+
+Three instrument kinds, Prometheus-shaped:
+
+- ``Counter``  — monotone ``inc(n)``; exported as ``<name>`` (counter).
+- ``Gauge``    — ``set(v)`` or ``set_fn(callable)`` (evaluated at snapshot
+  time — use for live queue depths so a scrape never reads a stale copy).
+- ``Histogram`` — sliding-window observations backed by
+  ``utils.metrics.PercentileWindow``; exported as a Prometheus *summary*
+  (p50/p99 quantiles + ``_count``/``_sum``).  ``add`` aliases ``observe``
+  so a histogram drops into ``utils.profiling.timed`` unchanged.
+
+Label sets: declare ``labelnames`` at registration, bind with
+``inst.labels(pool="native")``.  Binding unknown/missing label names
+raises; registering the same name twice with a different kind or label
+set raises (a silent second registration would split one metric across
+two objects).  Re-registering with the *same* spec returns the existing
+instrument, so independent subsystems (or repeated Trainer constructions
+in tests) share one instrument per name.
+
+Naming scheme (docs/OBSERVABILITY.md): ``r2d2dpg_<subsystem>_<metric>``
+with ``_total`` for counters and ``_seconds`` for time histograms.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from r2d2dpg_tpu.utils.metrics import PercentileWindow
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _check_name(name: str) -> str:
+    if not _NAME_RE.match(name):
+        raise ValueError(f"invalid metric name {name!r}")
+    return name
+
+
+class _Instrument:
+    """Shared shell: name/help/labelnames + the labelset -> cell table."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Tuple[str, ...]):
+        self.name = _check_name(name)
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        for ln in self.labelnames:
+            if not _LABEL_RE.match(ln):
+                raise ValueError(f"invalid label name {ln!r}")
+        self._lock = threading.Lock()
+        self._cells: Dict[Tuple[str, ...], object] = {}
+        if not self.labelnames:
+            self._cells[()] = self._new_cell()
+
+    def _new_cell(self):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def labels(self, **labelvalues: str):
+        """The cell for one concrete label set (created on first use)."""
+        if tuple(sorted(labelvalues)) != tuple(sorted(self.labelnames)):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labelvalues)} do not match "
+                f"declared labelnames {sorted(self.labelnames)}"
+            )
+        key = tuple(str(labelvalues[ln]) for ln in self.labelnames)
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                cell = self._cells[key] = self._new_cell()
+            return cell
+
+    def _only_cell(self):
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} declares labels {self.labelnames}; "
+                "bind them with .labels(...) first"
+            )
+        return self._cells[()]
+
+    def _cells_snapshot(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            return list(self._cells.items())
+
+
+class _CounterCell:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Counter(_Instrument):
+    """Monotone event count (requests, episodes, watchdog trips)."""
+
+    kind = "counter"
+
+    def _new_cell(self):
+        return _CounterCell()
+
+    def inc(self, n: float = 1.0) -> None:
+        self._only_cell().inc(n)
+
+    @property
+    def value(self) -> float:
+        return self._only_cell().value
+
+
+class _GaugeCell:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+            self._fn = None
+
+    def set_fn(self, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return float(fn())
+        except Exception:
+            # A dead callback (e.g. a stopped service) must not take the
+            # whole scrape down; NaN marks it visibly.
+            return float("nan")
+
+
+class Gauge(_Instrument):
+    """Point-in-time level (queue depth, occupancy, staleness)."""
+
+    kind = "gauge"
+
+    def _new_cell(self):
+        return _GaugeCell()
+
+    def set(self, v: float) -> None:
+        self._only_cell().set(v)
+
+    def set_fn(self, fn: Callable[[], float]) -> None:
+        """Pull-time callback: evaluated at each snapshot/scrape."""
+        self._only_cell().set_fn(fn)
+
+    @property
+    def value(self) -> float:
+        return self._only_cell().value
+
+
+class _HistogramCell:
+    def __init__(self, window: int):
+        self.window = PercentileWindow(window)
+
+    def observe(self, v: float) -> None:
+        self.window.add(v)
+
+    # timed() calls .add — histograms drop in wherever a PercentileWindow did.
+    add = observe
+
+    def snapshot(self) -> Tuple[int, float, float, float]:
+        """(count, total, p50, p99) under one window lock."""
+        return self.window.snapshot()
+
+    def percentiles(self, qs: Iterable[float] = (50.0, 99.0)):
+        return self.window.percentiles(qs)
+
+    @property
+    def count(self) -> int:
+        return self.window.count
+
+    @property
+    def total(self) -> float:
+        return self.window.total
+
+    def reset(self) -> None:
+        self.window.reset()
+
+
+class Histogram(_Instrument):
+    """Sliding-window distribution; exported as a Prometheus summary."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames, *, window: int = 2048):
+        self._window_size = window
+        super().__init__(name, help, labelnames)
+
+    def _new_cell(self):
+        return _HistogramCell(self._window_size)
+
+    def observe(self, v: float) -> None:
+        self._only_cell().observe(v)
+
+    add = observe
+
+    def snapshot(self) -> Tuple[int, float, float, float]:
+        return self._only_cell().snapshot()
+
+    def percentiles(self, qs: Iterable[float] = (50.0, 99.0)):
+        return self._only_cell().percentiles(qs)
+
+    @property
+    def count(self) -> int:
+        return self._only_cell().count
+
+    @property
+    def total(self) -> float:
+        return self._only_cell().total
+
+    def reset(self) -> None:
+        self._only_cell().reset()
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class Registry:
+    """Name -> instrument table with collision checking and snapshots."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, _Instrument] = {}
+
+    # -------------------------------------------------------------- register
+    def _register(self, cls, name: str, help: str, labelnames, **kw):
+        labelnames = tuple(labelnames)
+        with self._lock:
+            existing = self._instruments.get(name)
+            if existing is not None:
+                window = kw.get("window")
+                if (
+                    type(existing) is not cls
+                    or existing.labelnames != labelnames
+                    or (
+                        window is not None
+                        and getattr(existing, "_window_size", window)
+                        != window
+                    )
+                ):
+                    raise ValueError(
+                        f"instrument {name!r} already registered as "
+                        f"{existing.kind}{existing.labelnames} (window="
+                        f"{getattr(existing, '_window_size', None)}); "
+                        f"cannot re-register as {cls.kind}{labelnames} "
+                        f"with {kw or 'no kwargs'}"
+                    )
+                return existing
+            inst = cls(name, help, labelnames, **kw)
+            self._instruments[name] = inst
+            return inst
+
+    def counter(self, name: str, help: str = "", labelnames=()) -> Counter:
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames=()) -> Gauge:
+        return self._register(Gauge, name, help, labelnames)
+
+    def histogram(
+        self, name: str, help: str = "", labelnames=(), *, window: int = 2048
+    ) -> Histogram:
+        return self._register(
+            Histogram, name, help, labelnames, window=window
+        )
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        with self._lock:
+            return self._instruments.get(name)
+
+    def clear(self) -> None:
+        """Drop every instrument (tests only — live objects keep working
+        against their now-orphaned instruments)."""
+        with self._lock:
+            self._instruments.clear()
+
+    def _items(self) -> List[_Instrument]:
+        with self._lock:
+            return list(self._instruments.values())
+
+    # ------------------------------------------------------------- snapshots
+    def snapshot(self) -> Dict[str, dict]:
+        """JSON-able typed view: name -> {kind, help, samples: [...]}} where
+        each sample is {labels: {...}, value | count/total/p50/p99}."""
+        out: Dict[str, dict] = {}
+        for inst in self._items():
+            samples = []
+            for key, cell in inst._cells_snapshot():
+                labels = dict(zip(inst.labelnames, key))
+                if inst.kind == "histogram":
+                    count, total, p50, p99 = cell.snapshot()
+                    samples.append(
+                        {
+                            "labels": labels,
+                            "count": count,
+                            "total": total,
+                            "p50": p50,
+                            "p99": p99,
+                        }
+                    )
+                else:
+                    samples.append({"labels": labels, "value": cell.value})
+            out[inst.name] = {
+                "kind": inst.kind,
+                "help": inst.help,
+                "samples": samples,
+            }
+        return out
+
+    def scalars(self) -> Dict[str, float]:
+        """Flat name -> float view — the MetricLogger CSV/TB bridge.
+
+        Labelled samples flatten to ``name{a=x,b=y}``; histograms expand to
+        ``name_count`` / ``name_total`` / ``name_p50`` / ``name_p99``."""
+        out: Dict[str, float] = {}
+        for name, entry in self.snapshot().items():
+            for s in entry["samples"]:
+                labels = s["labels"]
+                suffix = (
+                    "{" + ",".join(f"{k}={v}" for k, v in labels.items()) + "}"
+                    if labels
+                    else ""
+                )
+                if entry["kind"] == "histogram":
+                    for field in ("count", "total", "p50", "p99"):
+                        out[f"{name}{suffix}_{field}"] = float(s[field])
+                else:
+                    out[f"{name}{suffix}"] = float(s["value"])
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (histograms as summaries)."""
+        lines: List[str] = []
+        for name, entry in self.snapshot().items():
+            if entry["help"]:
+                lines.append(f"# HELP {name} {entry['help']}")
+            ptype = "summary" if entry["kind"] == "histogram" else entry["kind"]
+            lines.append(f"# TYPE {name} {ptype}")
+            for s in entry["samples"]:
+                base = _label_str(s["labels"])
+                if entry["kind"] == "histogram":
+                    for q, field in (("0.5", "p50"), ("0.99", "p99")):
+                        lines.append(
+                            f"{name}{_label_str({**s['labels'], 'quantile': q})} "
+                            f"{_fmt(s[field])}"
+                        )
+                    lines.append(f"{name}_count{base} {_fmt(s['count'])}")
+                    lines.append(f"{name}_sum{base} {_fmt(s['total'])}")
+                else:
+                    lines.append(f"{name}{base} {_fmt(s['value'])}")
+        return "\n".join(lines) + "\n"
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    body = ",".join(
+        '{}="{}"'.format(
+            k, str(v).replace("\\", "\\\\").replace('"', '\\"')
+        )
+        for k, v in labels.items()
+    )
+    return "{" + body + "}"
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f) if not f.is_integer() else str(int(f))
+
+
+_REGISTRY = Registry()
+
+
+def get_registry() -> Registry:
+    """THE process-wide default registry (module singleton)."""
+    return _REGISTRY

@@ -44,7 +44,7 @@ from r2d2dpg_tpu.parallel.mesh import DP_AXIS
 from r2d2dpg_tpu.parallel.spmd import _state_spec
 from r2d2dpg_tpu.training.assembler import StepRecord, shift_in
 from r2d2dpg_tpu.training.trainer import Trainer, TrainerConfig, TrainerState
-from r2d2dpg_tpu.utils.profiling import annotate
+from r2d2dpg_tpu.utils.profiling import annotate, timed
 
 
 class HostSPMDTrainer(Trainer):
@@ -96,6 +96,15 @@ class HostSPMDTrainer(Trainer):
         super().__init__(env, agent, config)
         # Arena buffers carry explicit mesh shardings -> XLA scatter path.
         self.arena.use_pallas = False
+        # The one host<->device boundary per collected step, as seen from
+        # the stride loop (pool physics + numpy marshalling); the pool's
+        # own r2d2dpg_envpool_step_seconds isolates the physics share.
+        from r2d2dpg_tpu.obs import get_registry
+
+        self._obs_host_step = get_registry().histogram(
+            "r2d2dpg_hybrid_host_env_step_seconds",
+            "host env-step boundary latency in the hybrid stride loop",
+        )
 
     # --------------------------------------------------------------- builds
     def _build_phases(self):
@@ -382,7 +391,7 @@ class HostSPMDTrainer(Trainer):
             if on_step is not None:
                 on_step(t)
             # ═══ the one host<->device boundary per collected step ═══
-            with annotate("hybrid/host_env_step"):
+            with timed(self._obs_host_step), annotate("hybrid/host_env_step"):
                 o, r, d, res = self.env.host_step(action_np)
             rew_T.append(r)
             disc_T.append(d)

@@ -115,6 +115,40 @@ class ReplayArena:
         # Pallas needs single-device refs; trainers whose arena buffers carry
         # an explicit mesh sharding (parallel.hybrid) use the XLA scatter.
         self.use_pallas = use_pallas
+        # Telemetry (obs/): the arena itself is pure device code, so the
+        # host-side instruments are fed by whoever fetches the state —
+        # trainer/pipeline log paths call ``observe_state_scalars`` with
+        # values that rode the log cadence's existing batched device_get.
+        from r2d2dpg_tpu.obs import get_registry
+
+        reg = get_registry()
+        self._obs_capacity = reg.gauge(
+            "r2d2dpg_replay_capacity", "arena slot capacity (static)"
+        )
+        self._obs_capacity.set(float(capacity))
+        self._obs_occupancy = reg.gauge(
+            "r2d2dpg_replay_occupancy", "filled arena slots (min(added, cap))"
+        )
+        self._obs_priority_sum = reg.gauge(
+            "r2d2dpg_replay_priority_sum",
+            "sum of raw slot priorities (0 while empty)",
+        )
+        self._obs_added = reg.gauge(
+            "r2d2dpg_replay_sequences_added",
+            "monotone count of sequences ever added",
+        )
+
+    def observe_state_scalars(
+        self, occupancy: float, priority_sum: float, total_added: float
+    ) -> None:
+        """Publish host-fetched arena scalars onto the obs registry.
+
+        Called on the log cadence with values from the SAME batched
+        ``jax.device_get`` that drains the episode accumulators — the
+        telemetry layer adds no host syncs of its own."""
+        self._obs_occupancy.set(occupancy)
+        self._obs_priority_sum.set(priority_sum)
+        self._obs_added.set(total_added)
 
     # ------------------------------------------------------------------ init
     def init_state(self, example: SequenceBatch) -> ArenaState:

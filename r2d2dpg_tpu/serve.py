@@ -70,6 +70,22 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="seconds between health rows written to --logdir"
     )
     p.add_argument(
+        "--obs-port", type=int, default=None, metavar="PORT",
+        help="serve the telemetry registry over HTTP: /metrics (Prometheus "
+        "text, incl. the r2d2dpg_serving_* health gauges) + /metrics.json; "
+        "0 binds an ephemeral port (printed to stderr)"
+    )
+    p.add_argument(
+        "--obs-host", default="0.0.0.0",
+        help="interface the --obs-port exporter binds (127.0.0.1 = "
+        "loopback-only on shared hosts)"
+    )
+    p.add_argument(
+        "--flight-path", default=None,
+        help="flight-recorder dump path (default <logdir>/flight.jsonl, "
+        "or ./flight.jsonl without --logdir)"
+    )
+    p.add_argument(
         "--selftest", type=int, default=0, metavar="N",
         help="drive N synthetic requests through the service and exit"
     )
@@ -177,7 +193,28 @@ def _selftest(service, obs_shape, n: int) -> None:
 
 def main(argv=None) -> None:
     args = parse_args(argv)
+    import os
+
     import jax
+
+    from r2d2dpg_tpu import obs
+
+    flight_path = args.flight_path or (
+        os.path.join(args.logdir, "flight.jsonl")
+        if args.logdir
+        else "flight.jsonl"
+    )
+    if args.logdir or args.flight_path:
+        # Same gating as train.py: arm the exit-time dump only when the
+        # operator named a destination.
+        obs.get_flight_recorder().install(flight_path)
+    if args.obs_port is not None:
+        exporter = obs.start_exporter(args.obs_port, host=args.obs_host)
+        print(
+            f"obs: /metrics + /metrics.json on port {exporter.port}",
+            file=sys.stderr,
+            flush=True,
+        )
 
     service, env = build_service(args)
     # Same backend stamp train.py prints — automation gates on it.

@@ -13,6 +13,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Optional
 
+from r2d2dpg_tpu.obs import get_registry
+
 
 @dataclasses.dataclass(frozen=True)
 class HealthSnapshot:
@@ -57,3 +59,14 @@ class HealthSnapshot:
         out.pop("last_reload_error")
         out.pop("last_worker_error")
         return {k: float(v) for k, v in out.items()}
+
+    def publish(self, registry=None) -> None:
+        """Refit the scalar view onto the obs registry as
+        ``r2d2dpg_serving_<field>`` gauges, so the /metrics scrape sees the
+        same numbers the CSV/TB health rows and the JSONL health API show.
+        Registration is idempotent — each publish is a set() per field."""
+        reg = registry if registry is not None else get_registry()
+        for k, v in self.as_scalars().items():
+            reg.gauge(
+                f"r2d2dpg_serving_{k}", "PolicyService health field"
+            ).set(v)

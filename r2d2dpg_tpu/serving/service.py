@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from r2d2dpg_tpu.models.actor_critic import ActorNet, policy_step_fn
+from r2d2dpg_tpu.obs import flight_event
 from r2d2dpg_tpu.serving.batcher import (
     OK,
     SHED_QUEUE,
@@ -129,6 +130,11 @@ class PolicyService:
         self._logger = logger
         self._log_every_s = log_every_s
         self._last_log_t = clock()
+        # Registry publish cadence (obs/): health gauges refresh at 1 Hz —
+        # decoupled from the (slower) CSV/TB log cadence so a /metrics
+        # scrape never reads data older than ~a second.
+        self._obs_every_s = 1.0
+        self._last_obs_t = clock()
         self._latency_win = PercentileWindow()
         self._step_win = PercentileWindow()
         self._occupancy_ema = 0.0
@@ -215,6 +221,8 @@ class PolicyService:
             # tell the client which (a shed invites backoff-and-retry, a
             # shutdown doesn't).
             code = SHUTDOWN if self.batcher.closed else SHED_QUEUE
+            if code == SHED_QUEUE:
+                flight_event("shed", code=code, session=req.session_id)
             req.finish(code, clock=self._clock)
             return req
         return req
@@ -268,6 +276,7 @@ class PolicyService:
         with self._stats_lock:
             self._worker_errors += 1
             self._last_worker_error = f"{type(exc).__name__}: {exc}"
+        flight_event("worker_error", error=self._last_worker_error)
 
     def _recover_from_worker_error(self, exc: Exception, batch) -> None:
         """Fail the affected requests, rebuild device state, keep serving.
@@ -299,7 +308,15 @@ class PolicyService:
             if fresh is not None:
                 self._params = fresh
                 self._params_step = self.reloader.current_step
-        self.sessions.evict_expired()
+                flight_event(
+                    "hot_reload", params_step=int(self._params_step)
+                )
+        evicted = self.sessions.evict_expired()
+        if evicted:
+            flight_event("ttl_eviction", count=int(evicted))
+        if self._clock() - self._last_obs_t >= self._obs_every_s:
+            self._last_obs_t = self._clock()
+            self.health().publish()
         if (
             self._logger is not None
             and self._clock() - self._last_log_t >= self._log_every_s
@@ -333,6 +350,9 @@ class PolicyService:
             if got is None:
                 with self._stats_lock:
                     self._shed_sessions += 1
+                flight_event(
+                    "shed", code=SHED_SESSIONS, session=req.session_id
+                )
                 req.finish(SHED_SESSIONS, clock=self._clock)
                 continue
             slot, is_new = got

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 import time
 from typing import Optional
 
@@ -106,6 +107,40 @@ def parse_args(argv=None) -> argparse.Namespace:
     # Debug / profiling.
     p.add_argument("--profile-phases", type=int, default=0, help="trace this many train phases into --logdir/profile")
     p.add_argument("--nan-debug", action="store_true")
+    # Observability (docs/OBSERVABILITY.md).
+    p.add_argument(
+        "--obs-port", type=int, default=None, metavar="PORT",
+        help="serve the telemetry registry over HTTP: /metrics (Prometheus "
+        "text) + /metrics.json (JSON snapshot); 0 binds an ephemeral port "
+        "(resolved port printed and written to <logdir>/obs_port.txt)"
+    )
+    p.add_argument(
+        "--obs-host", default="0.0.0.0",
+        help="interface the --obs-port exporter binds (127.0.0.1 = "
+        "loopback-only on shared hosts)"
+    )
+    p.add_argument(
+        "--flight-path", default=None,
+        help="flight-recorder dump path (default <logdir>/flight.jsonl, "
+        "or ./flight.jsonl without --logdir)"
+    )
+    p.add_argument(
+        "--watchdog", type=int, default=1, choices=[0, 1],
+        help="divergence watchdog on the log cadence: NaN/Inf or norm "
+        "blow-up in learner outputs aborts loudly with a flight-recorder "
+        "dump and a last-good-checkpoint pointer (1 = on)"
+    )
+    p.add_argument("--watchdog-grad-norm", type=float, default=1e6,
+                   help="watchdog trip threshold for grad_norm")
+    p.add_argument("--watchdog-param-norm", type=float, default=1e7,
+                   help="watchdog trip threshold for param_norm")
+    p.add_argument(
+        "--nan-inject-phase", type=int, default=None, metavar="K",
+        help="FAULT INJECTION (tests/drills): poison the actor params with "
+        "NaN after the K-th train phase, so the next learner update "
+        "produces non-finite outputs and the watchdog path is exercised "
+        "end to end"
+    )
     return p.parse_args(argv)
 
 
@@ -149,6 +184,7 @@ def run(args) -> dict:
     """Drive one experiment; returns the final metrics dict."""
     import jax
 
+    from r2d2dpg_tpu import obs
     from r2d2dpg_tpu.training.evaluator import Evaluator
     from r2d2dpg_tpu.utils import (
         CheckpointManager,
@@ -169,6 +205,11 @@ def run(args) -> dict:
             "--pipeline 1 does not support --resume/--eval-every/"
             "--profile-phases yet"
         )
+    if args.pipeline and args.nan_inject_phase is not None:
+        raise SystemExit(
+            "--nan-inject-phase targets the phase-locked loop; "
+            "use --pipeline 0 for watchdog drills"
+        )
 
     cfg = _apply_overrides(get_config(args.config), args)
 
@@ -185,11 +226,46 @@ def run(args) -> dict:
     backend = jax.default_backend()
     print(f"backend: {backend}", flush=True)
     if args.logdir:
-        import os
-
         os.makedirs(args.logdir, exist_ok=True)
         with open(os.path.join(args.logdir, "backend.txt"), "w") as f:
             f.write(backend + "\n")
+
+    # ------------------------------------------------------------ telemetry
+    # Flight recorder is ALWAYS armed (an in-memory ring is ~free; the dump
+    # is exit-time); the exporter and the CSV-bridge fold are --obs-port
+    # opt-in; the watchdog is on by default (--watchdog 0 to drop it).
+    registry = obs.get_registry()
+    flight = obs.get_flight_recorder()
+    flight_path = args.flight_path or (
+        os.path.join(args.logdir, "flight.jsonl")
+        if args.logdir
+        else "flight.jsonl"
+    )
+    if args.logdir or args.flight_path:
+        # Exit-time dump armed only when the operator named a destination
+        # (no surprise ./flight.jsonl litter from bare smoke runs); the
+        # watchdog abort path dumps explicitly either way.
+        flight.install(flight_path)
+    exporter = None
+    if args.obs_port is not None:
+        exporter = obs.start_exporter(args.obs_port, registry, args.obs_host)
+        print(
+            f"obs: /metrics + /metrics.json on port {exporter.port}",
+            flush=True,
+        )
+        if args.logdir:
+            with open(os.path.join(args.logdir, "obs_port.txt"), "w") as f:
+                f.write(f"{exporter.port}\n")
+    watchdog = (
+        obs.DivergenceWatchdog(
+            obs.WatchdogConfig(
+                grad_norm_max=args.watchdog_grad_norm,
+                param_norm_max=args.watchdog_param_norm,
+            )
+        )
+        if args.watchdog
+        else None
+    )
 
     ckpt: Optional[CheckpointManager] = None
     if args.checkpoint_dir:
@@ -205,7 +281,9 @@ def run(args) -> dict:
             cfg.env_factory(), trainer.agent.actor, num_envs=args.eval_envs
         )
 
-    logger = MetricLogger(args.logdir)
+    logger = MetricLogger(
+        args.logdir, registry=registry if exporter is not None else None
+    )
     deadline = (
         time.monotonic() + args.minutes * 60 if args.minutes is not None else None
     )
@@ -219,13 +297,17 @@ def run(args) -> dict:
         state = trainer.init()
 
     if args.pipeline:
-        return _run_pipelined(trainer, state, logger, ckpt, args)
+        return _run_pipelined(
+            trainer, state, logger, ckpt, args, watchdog, flight, flight_path
+        )
 
     warm = trainer.window_fill_phases
     fill = warm + trainer.replay_fill_phases
     eval_key = jax.random.PRNGKey(cfg.trainer.seed + 1)
     last_learn = {}
     final = {}
+    train_phases_done = 0
+    diverged = False
     phase = start = int(state.phase_idx)
     # --phases counts *train* phases for this invocation: a fresh run stops
     # after fill + N, a resumed one after N more from wherever it restarted.
@@ -258,6 +340,9 @@ def run(args) -> dict:
                     profiler_cm = profile_trace(f"{args.logdir}/profile")
                     profiler_cm.__enter__()
                 state, last_learn = trainer.train_phase(state)
+                train_phases_done += 1
+                if train_phases_done == args.nan_inject_phase:
+                    state = _poison_actor_params(state)
                 if profiler_cm is not None and phase + 1 >= profile_until:
                     jax.block_until_ready(state.train.step)
                     profiler_cm.__exit__(None, None, None)
@@ -275,6 +360,8 @@ def run(args) -> dict:
                 scalars.update(
                     {k: float(v) for k, v in learn_np.items()}
                 )
+                trainer._obs_publish({"learner_steps": float(lstep)})
+                watch_scalars = dict(scalars)
                 scalars.update(
                     logger.rates(
                         env_steps=ep["env_steps"],
@@ -283,6 +370,11 @@ def run(args) -> dict:
                 )
                 logger.log(phase, scalars)
                 final = scalars
+                if watchdog is not None:
+                    # Rides the fetch above — no extra host syncs; checked
+                    # AFTER the log call so the poisoned row is on disk as
+                    # forensic evidence when the run aborts.
+                    watchdog.check(phase, watch_scalars)
 
             if ckpt is not None and ckpt.save_every:
                 ckpt.maybe_save(phase, state)
@@ -299,11 +391,16 @@ def run(args) -> dict:
                 ev["env_steps"] = float(state.env_steps)
                 logger.log(phase, ev)
                 final.update(ev)
+    except obs.DivergenceError as e:
+        diverged = True
+        _abort_on_divergence(e, flight, flight_path, ckpt)
     finally:
         if profiler_cm is not None:
             profiler_cm.__exit__(None, None, None)
         if ckpt is not None:
-            if ckpt.save_every:
+            if ckpt.save_every and not diverged:
+                # A diverged state must NOT become the "final" checkpoint —
+                # it would shadow the last good one the abort points at.
                 ckpt.save_final(phase, state)
             ckpt.wait()
             ckpt.close()
@@ -311,13 +408,61 @@ def run(args) -> dict:
     return final
 
 
-def _run_pipelined(trainer, state, logger, ckpt, args) -> dict:
+def _poison_actor_params(state):
+    """--nan-inject-phase fault injection: NaN every actor-param leaf, so
+    the next learner update's outputs (losses, norms) go non-finite through
+    the REAL divergence propagation path."""
+    import jax
+    import jax.numpy as jnp
+
+    poisoned = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, jnp.nan), state.train.actor_params
+    )
+    return dataclasses.replace(
+        state, train=dataclasses.replace(state.train, actor_params=poisoned)
+    )
+
+
+def _abort_on_divergence(e, flight, flight_path, ckpt) -> None:
+    """Watchdog trip: dump the flight ring, point at the last good
+    checkpoint, exit non-zero (SystemExit(2))."""
+    import sys
+
+    flight.record("abort", reason=str(e), step=e.step)
+    dumped = flight.dump(flight_path)
+    if ckpt is not None and ckpt.latest_step is not None:
+        # Honesty about the detection window: the watchdog sees learner
+        # outputs once per log cadence, so a checkpoint cadence FINER than
+        # the log cadence can have saved an already-poisoned state before
+        # the trip.  The abort never overwrites anything (final save is
+        # skipped); the operator verifies before resuming.
+        pointer = (
+            f"{ckpt.directory} step {ckpt.latest_step} (verify before "
+            f"resuming: a save inside the last log cadence may already "
+            f"carry the divergence)"
+        )
+    else:
+        pointer = "none on disk"
+    print(
+        f"watchdog: DIVERGENCE at step {e.step}: {e.reason}\n"
+        f"watchdog: flight recorder dumped to {dumped}\n"
+        f"watchdog: last-good checkpoint: {pointer}",
+        file=sys.stderr,
+        flush=True,
+    )
+    raise SystemExit(2)
+
+
+def _run_pipelined(
+    trainer, state, logger, ckpt, args, watchdog, flight, flight_path
+) -> dict:
     """Drive the run through the pipelined executor (--pipeline 1).
 
     The executor owns the warm-up -> fill -> train schedule and the log
     cadence; metrics land in the same MetricLogger (CSV/TB) rows as the
     phase-locked loop, and a final checkpoint is saved when a checkpoint
     dir is configured."""
+    from r2d2dpg_tpu.obs import DivergenceError
     from r2d2dpg_tpu.training.pipeline import PipelineConfig, PipelineExecutor
 
     executor = PipelineExecutor(
@@ -346,6 +491,7 @@ def _run_pipelined(trainer, state, logger, ckpt, args) -> dict:
 
     def metrics_fn(phase: int, scalars) -> None:
         scalars = dict(scalars)
+        watch_scalars = dict(scalars)
         scalars.update(
             logger.rates(
                 env_steps=scalars.get("env_steps", 0.0),
@@ -355,6 +501,10 @@ def _run_pipelined(trainer, state, logger, ckpt, args) -> dict:
         logger.log(phase, scalars)
         final.clear()
         final.update(scalars)
+        if watchdog is not None:
+            # Raises DivergenceError through the executor's learner loop,
+            # whose finally-block stops and joins the collector thread.
+            watchdog.check(phase, watch_scalars)
 
     try:
         state = executor.run(
@@ -374,6 +524,8 @@ def _run_pipelined(trainer, state, logger, ckpt, args) -> dict:
             final.update({f"pipeline_{k}": v for k, v in stats.items()})
         if ckpt is not None and ckpt.save_every:
             ckpt.save_final(int(state.phase_idx), state)
+    except DivergenceError as e:
+        _abort_on_divergence(e, flight, flight_path, ckpt)
     finally:
         if ckpt is not None:
             ckpt.wait()

@@ -62,11 +62,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from r2d2dpg_tpu.obs import flight_event, get_registry
 from r2d2dpg_tpu.replay.arena import StagedSequences
 from r2d2dpg_tpu.training.assembler import emit
 from r2d2dpg_tpu.training.trainer import Trainer, TrainerState
-from r2d2dpg_tpu.utils.metrics import PercentileWindow
-from r2d2dpg_tpu.utils.profiling import annotate, scope, timed
+from r2d2dpg_tpu.utils.profiling import annotate, scope
+
+# A single queue wait this long is operator-worthy: it lands in the flight
+# recorder as a ``queue_stall`` event (the percentile windows keep the full
+# distribution either way).
+_STALL_EVENT_S = 1.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -290,7 +295,9 @@ class PipelineExecutor:
             seq=self._emit_prog(cstate.window), priorities=None
         )
 
-    def _publish(self, box: _ParamBox, train) -> Any:
+    def _publish(
+        self, box: _ParamBox, train, phase: int = -1, record: bool = True
+    ) -> Any:
         """Copy + publish the learner's behavior params (donation safety).
 
         Published EVERY drain phase even when the collector reads only
@@ -298,16 +305,40 @@ class PipelineExecutor:
         invalidated by the next drain's donation before the collector
         copies it, and publishing on the collector's cadence would add a
         publication-age term to the documented staleness bound.  The cost
-        is two small param-tree copies next to K full learner updates."""
+        is two small param-tree copies next to K full learner updates.
+
+        ``record=False`` skips the flight event: a per-drain-phase event
+        would flood the bounded ring at tens of phases per second and
+        evict the rare events (checkpoint saves, stalls, sheds) a
+        post-mortem actually needs — the caller records on the log
+        cadence instead."""
         cp = lambda tree: jax.tree_util.tree_map(jnp.copy, tree)  # noqa: E731
         actor = cp(train.actor_params)
         box.publish(actor, cp(self.trainer.agent.behavior_critic_params(train)))
+        if record:
+            flight_event("param_publish", phase=phase)
         return actor
 
     # ------------------------------------------------------------------ runs
     def _reset_stats(self) -> None:
-        self.learner_wait = PercentileWindow()
-        self.collect_wait = PercentileWindow()
+        # Registry histograms (obs/): same PercentileWindow backend the bare
+        # windows used, but scrapeable via /metrics while a section runs.
+        # Reset at each section start so stats() stays per-section.
+        reg = get_registry()
+        self.learner_wait = reg.histogram(
+            "r2d2dpg_pipeline_learner_wait_seconds",
+            "learner thread blocked on the staging queue (starvation)",
+        )
+        self.collect_wait = reg.histogram(
+            "r2d2dpg_pipeline_collect_wait_seconds",
+            "collector thread blocked on the staging queue (backpressure)",
+        )
+        self._obs_queue_depth = reg.gauge(
+            "r2d2dpg_pipeline_staging_queue_depth",
+            "staged collect phases awaiting drain",
+        )
+        self.learner_wait.reset()
+        self.collect_wait.reset()
         self._stats: Dict[str, float] = {}
 
     def stats(self) -> Dict[str, float]:
@@ -436,8 +467,12 @@ class PipelineExecutor:
         self._reset_stats()
         cstate, lstate = split_state(state)
         box = _ParamBox(None, None)
-        self._publish(box, lstate.train)
+        self._publish(box, lstate.train, phase0)
         q: queue.Queue = queue.Queue(maxsize=cfg.queue_depth)
+        # Live depth at scrape time (set_fn: evaluated per snapshot).  The
+        # queue outlives the section only as an empty object, so a late
+        # scrape correctly reads 0.
+        self._obs_queue_depth.set_fn(q.qsize)
         stop = threading.Event()
         collector_err: list = []
         result: Dict[str, Any] = {}
@@ -482,13 +517,20 @@ class PipelineExecutor:
                             completed_count=jnp.zeros(()),
                         )
                     item = (gphase, staged, ep_refs)
-                    with timed(self.collect_wait):
-                        while not stop.is_set():
-                            try:
-                                q.put(item, timeout=0.2)
-                                break
-                            except queue.Full:
-                                continue
+                    t_wait = time.monotonic()
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    waited = time.monotonic() - t_wait
+                    self.collect_wait.add(waited)
+                    if waited >= _STALL_EVENT_S:
+                        flight_event(
+                            "queue_stall", side="collector",
+                            phase=gphase, seconds=round(waited, 3),
+                        )
             except BaseException as e:  # surfaced on the learner thread
                 collector_err.append(e)
             finally:
@@ -505,21 +547,42 @@ class PipelineExecutor:
         drained = 0
         try:
             while True:
-                with timed(self.learner_wait):
-                    item = q.get()
+                t_wait = time.monotonic()
+                item = q.get()
+                waited = time.monotonic() - t_wait
+                self.learner_wait.add(waited)
+                if waited >= _STALL_EVENT_S:
+                    flight_event(
+                        "queue_stall", side="learner",
+                        phase=phase0 + drained + 1, seconds=round(waited, 3),
+                    )
                 if item is None:
                     break
                 gphase, staged, ep_refs = item
                 with annotate("pipeline/learn"):
                     ls, metrics = self._drain_prog(ls, staged)
-                behavior_final = self._publish(box, ls.train)
+                behavior_final = self._publish(
+                    box, ls.train, gphase, record=ep_refs is not None
+                )
                 drained += 1
                 if ep_refs is not None:
                     # ONE batched fetch per log cadence: episode stats,
-                    # learner step counter, and the phase's learn metrics.
-                    env_steps, ret_sum, count, lstep, m = jax.device_get(
-                        (*ep_refs, ls.train.step, metrics)
-                    )
+                    # learner step counter, the phase's learn metrics, and
+                    # the arena telemetry scalars (obs/ rides this fetch —
+                    # no host syncs of its own).  Same guard as
+                    # pop_episode_metrics: a multi-process fleet's arena is
+                    # not fully addressable per process, so eager
+                    # reductions on it are skipped.
+                    refs = [*ep_refs, ls.train.step, metrics]
+                    single_proc = jax.process_count() == 1
+                    if single_proc:
+                        refs += [
+                            t.arena.size(ls.arena),
+                            ls.arena.priority.sum(),
+                            ls.arena.total_added,
+                        ]
+                    fetched = jax.device_get(tuple(refs))
+                    env_steps, ret_sum, count, lstep, m = fetched[:5]
                     count = float(count)
                     ep = {
                         "episode_return_mean": float(ret_sum) / max(count, 1.0),
@@ -527,6 +590,12 @@ class PipelineExecutor:
                         "env_steps": float(env_steps),
                         "learner_steps": float(lstep),
                     }
+                    if single_proc:
+                        occ, psum, added = fetched[5:]
+                        t.arena.observe_state_scalars(
+                            float(occ), float(psum), float(added)
+                        )
+                    t._obs_publish(ep)
                     emit_log(
                         gphase, ep, {k: float(v) for k, v in m.items()}
                     )
@@ -539,24 +608,32 @@ class PipelineExecutor:
                 except queue.Empty:
                     thread.join(timeout=0.2)
             thread.join()
+            # Rebind the depth gauge to a literal 0: the section is over,
+            # and the set_fn closure would otherwise (a) report leftover
+            # sentinel/staged items as live depth after an abort and
+            # (b) pin the queue's device-resident payloads until the next
+            # section rebinds it.
+            self._obs_queue_depth.set(0.0)
         if collector_err:
             raise collector_err[0]
         jax.block_until_ready(ls.train.step)
         wall = max(time.monotonic() - t0, 1e-9)
-        lw_p50, lw_p99 = self.learner_wait.percentiles()
-        cw_p50, cw_p99 = self.collect_wait.percentiles()
+        # One consistent (count, total, p50, p99) per window — a single
+        # locked read each, not three (PercentileWindow.snapshot).
+        _, lw_total, lw_p50, lw_p99 = self.learner_wait.snapshot()
+        _, cw_total, cw_p50, cw_p99 = self.collect_wait.snapshot()
         self._stats = {
             "train_phases": float(drained),
             "wall_s": wall,
             "learner_steps_per_sec": drained * t.config.learner_steps / wall,
             "learner_wait_p50_ms": lw_p50 * 1e3,
             "learner_wait_p99_ms": lw_p99 * 1e3,
-            "learner_wait_total_s": self.learner_wait.total,
+            "learner_wait_total_s": lw_total,
             "collect_wait_p50_ms": cw_p50 * 1e3,
             "collect_wait_p99_ms": cw_p99 * 1e3,
-            "collect_wait_total_s": self.collect_wait.total,
+            "collect_wait_total_s": cw_total,
             "overlap_fraction": float(
-                np.clip(1.0 - self.learner_wait.total / wall, 0.0, 1.0)
+                np.clip(1.0 - lw_total / wall, 0.0, 1.0)
             ),
         }
         return merge_state(state, result["cstate"], ls, behavior_final)

@@ -118,6 +118,24 @@ class Trainer:
             alpha=config.priority_alpha,
         )
         self.global_envs = config.num_envs
+        # Telemetry (obs/): registration is idempotent, so repeated Trainer
+        # constructions (tests, eval) share one instrument per name.
+        from r2d2dpg_tpu.obs import get_registry
+
+        reg = get_registry()
+        self._obs_env_steps = reg.gauge(
+            "r2d2dpg_trainer_env_steps", "fleet-wide env steps collected"
+        )
+        self._obs_learner_steps = reg.gauge(
+            "r2d2dpg_trainer_learner_steps", "learner updates applied"
+        )
+        self._obs_return = reg.gauge(
+            "r2d2dpg_trainer_episode_return_mean",
+            "mean return of episodes completed since the previous log",
+        )
+        self._obs_episodes = reg.counter(
+            "r2d2dpg_trainer_episodes_total", "episodes completed"
+        )
         self._build_phases()
 
     def _build_phases(self):
@@ -499,24 +517,53 @@ class Trainer:
     ) -> Tuple[TrainerState, Dict[str, float]]:
         """Host-side: drain the completed-episode accumulators (L6 logging).
 
-        ONE batched ``jax.device_get`` for all three scalars — three
-        separate ``float(...)`` casts were three blocking host syncs per
-        log call.  Callers invoke this only on the log cadence."""
-        count, ret_sum, env_steps = jax.device_get(
-            (state.completed_count, state.completed_return_sum, state.env_steps)
-        )
+        ONE batched ``jax.device_get`` for all scalars — separate
+        ``float(...)`` casts were that many blocking host syncs per log
+        call.  Callers invoke this only on the log cadence.  The arena's
+        telemetry scalars (occupancy, priority-sum) ride the same fetch;
+        multi-process fleets skip them (the replicated arena is not fully
+        addressable from one process, and eager reductions on it would
+        deadlock the SPMD schedule)."""
+        refs = [state.completed_count, state.completed_return_sum, state.env_steps]
+        single_proc = jax.process_count() == 1
+        if single_proc:
+            refs += [
+                self.arena.size(state.arena),
+                state.arena.priority.sum(),
+                state.arena.total_added,
+            ]
+        fetched = jax.device_get(tuple(refs))
+        count, ret_sum, env_steps = fetched[:3]
         count = float(count)
         metrics = {
             "episode_return_mean": float(ret_sum) / max(count, 1.0),
             "episodes": count,
             "env_steps": float(env_steps),
         }
+        if single_proc:
+            occ, psum, added = fetched[3:]
+            self.arena.observe_state_scalars(
+                float(occ), float(psum), float(added)
+            )
+        self._obs_publish(metrics)
         state = dataclasses.replace(
             state,
             completed_return_sum=jnp.zeros(()),
             completed_count=jnp.zeros(()),
         )
         return state, metrics
+
+    def _obs_publish(self, metrics: Dict[str, float]) -> None:
+        """Fold one log cadence's host-side scalars onto the obs registry
+        (shared by the phase-locked and pipelined log paths)."""
+        if "env_steps" in metrics:
+            self._obs_env_steps.set(metrics["env_steps"])
+        if "episode_return_mean" in metrics:
+            self._obs_return.set(metrics["episode_return_mean"])
+        if "learner_steps" in metrics:
+            self._obs_learner_steps.set(metrics["learner_steps"])
+        if metrics.get("episodes"):
+            self._obs_episodes.inc(metrics["episodes"])
 
     # ----------------------------------------------------------- main loop
     def run(

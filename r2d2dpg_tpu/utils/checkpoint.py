@@ -88,11 +88,21 @@ class CheckpointManager:
         """Save at ``step``, overwriting an existing same-step checkpoint
         (a light-resume run restarts its phase numbering at 0, so a
         resumed run legitimately revisits steps already on disk)."""
+        from r2d2dpg_tpu.obs import flight_event
+
         self._check_layout(saving=True)
         if step in (self._mgr.all_steps() or []):
             self._mgr.delete(step)
         payload = {"train": state.train} if self.light else state
         self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        # The flight recorder's checkpoint trail is what the divergence
+        # watchdog's "last-good checkpoint" pointer reads at abort time.
+        flight_event(
+            "checkpoint_save",
+            step=int(step),
+            directory=self.directory,
+            light=self.light,
+        )
 
     def save_final(self, step: int, state: Any) -> None:
         """End-of-run save; no-op when the cadence already saved ``step``

@@ -15,6 +15,7 @@ timing prints (SURVEY §5.1).  The build wires the native JAX tooling:
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Iterator, Optional
 
 import jax
@@ -67,9 +68,10 @@ def timed(window) -> Iterator[None]:
 
     The pipelined executor's per-stage wait instrumentation: wrap the
     queue-blocking section of each stage and read p50/p99 plus the running
-    total off the window (``utils.metrics.PercentileWindow``)."""
-    import time
-
+    total off the window (``utils.metrics.PercentileWindow`` or an
+    ``obs.Histogram`` — anything with ``add``).  ``time`` is imported at
+    module scope: this context manager runs inside per-stage hot loops and
+    a per-call import was measurable overhead there."""
     t0 = time.monotonic()
     try:
         yield

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# lint_obs.sh — operator output must flow through the telemetry layer.
+#
+# Fails on bare `print(` in r2d2dpg_tpu/ library code.  Library modules
+# report through the obs registry / flight recorder / MetricLogger so that
+# every operator-visible signal is scrapeable and post-mortem-able; a bare
+# print is invisible to both.
+#
+# Exceptions:
+#   - CLI entrypoints (train.py, serve.py, eval.py, __main__.py): their
+#     job is stdout/stderr.
+#   - Lines annotated `# obs-lint: allow` (e.g. MetricLogger's own stdout
+#     sink, which IS the telemetry layer's print).
+#
+# Wired into the test run via tests/test_obs.py::test_lint_obs_clean.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+offenders=$(grep -rn 'print(' r2d2dpg_tpu \
+    --include='*.py' \
+    --exclude='train.py' \
+    --exclude='serve.py' \
+    --exclude='eval.py' \
+    --exclude='__main__.py' \
+    | grep -v '# obs-lint: allow' || true)
+
+if [ -n "$offenders" ]; then
+    echo "$offenders"
+    echo "lint_obs: FAIL — bare print( in library code; route operator" \
+         "output through the obs registry / flight recorder / MetricLogger" \
+         "(or annotate deliberate sinks with '# obs-lint: allow')"
+    exit 1
+fi
+echo "lint_obs: OK"

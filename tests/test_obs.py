@@ -1,0 +1,634 @@
+"""Unified telemetry tests (ISSUE 3): instrument registry, /metrics
+exporter, flight recorder, divergence watchdog, MetricLogger thread-safety
+and append-only CSV, PercentileWindow edge cases, and the obs lint gate.
+"""
+
+import csv
+import json
+import os
+import subprocess
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu import obs
+from r2d2dpg_tpu.obs.registry import Registry
+from r2d2dpg_tpu.utils.metrics import MetricLogger, PercentileWindow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ registry
+def test_counter_gauge_histogram_basics():
+    reg = Registry()
+    c = reg.counter("x_total", "a counter")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = reg.gauge("x_gauge")
+    g.set(7)
+    assert g.value == 7.0
+    g.set_fn(lambda: 42.0)
+    assert g.value == 42.0
+    g.set(1.0)  # set() clears the callback
+    assert g.value == 1.0
+
+    h = reg.histogram("x_seconds")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    count, total, p50, p99 = h.snapshot()
+    assert (count, total) == (4, 10.0)
+    assert p50 == 2.0 and p99 == 4.0
+    h.add(5.0)  # .add aliases .observe (drop-in for utils.profiling.timed)
+    assert h.count == 5
+
+
+def test_registry_duplicate_and_collision_errors():
+    reg = Registry()
+    c1 = reg.counter("dup_total", "first")
+    # Same spec: idempotent — the SAME instrument comes back.
+    assert reg.counter("dup_total") is c1
+    # Different kind under the same name: loud error.
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("dup_total")
+    # Same kind, different label set: loud error.
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("dup_total", labelnames=("pool",))
+    # Histogram window size is part of the spec too.
+    reg.histogram("dup_seconds", window=64)
+    assert reg.histogram("dup_seconds", window=64) is not None
+    with pytest.raises(ValueError, match="already registered"):
+        reg.histogram("dup_seconds", window=128)
+    # Invalid metric / label names: rejected at registration.
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("bad name")
+    with pytest.raises(ValueError, match="invalid label name"):
+        reg.counter("ok_total", labelnames=("bad-label",))
+
+
+def test_label_set_binding_and_collisions():
+    reg = Registry()
+    c = reg.counter("lbl_total", "labelled", labelnames=("pool",))
+    c.labels(pool="native").inc(2)
+    c.labels(pool="python").inc(1)
+    # Same label values -> same cell.
+    assert c.labels(pool="native").value == 2.0
+    # Wrong / missing / extra label names: loud errors.
+    with pytest.raises(ValueError, match="do not match"):
+        c.labels(wrong="x")
+    with pytest.raises(ValueError, match="do not match"):
+        c.labels()
+    with pytest.raises(ValueError, match="do not match"):
+        c.labels(pool="native", extra="y")
+    # Unlabeled shortcut on a labelled instrument: loud error.
+    with pytest.raises(ValueError, match="declares labels"):
+        c.inc()
+    scalars = reg.scalars()
+    assert scalars["lbl_total{pool=native}"] == 2.0
+    assert scalars["lbl_total{pool=python}"] == 1.0
+
+
+def test_prometheus_text_and_json_snapshot():
+    reg = Registry()
+    reg.counter("t_total", "help text").inc(3)
+    reg.gauge("t_gauge").set(1.5)
+    h = reg.histogram("t_lat_seconds", labelnames=("pool",))
+    h.labels(pool="native").observe(0.5)
+    text = reg.prometheus_text()
+    assert "# HELP t_total help text" in text
+    assert "# TYPE t_total counter" in text
+    assert "t_total 3" in text
+    assert "t_gauge 1.5" in text
+    assert "# TYPE t_lat_seconds summary" in text
+    assert 't_lat_seconds{pool="native",quantile="0.5"} 0.5' in text
+    assert 't_lat_seconds_count{pool="native"} 1' in text
+    snap = reg.snapshot()
+    json.dumps(snap)  # JSON-able
+    assert snap["t_total"]["kind"] == "counter"
+    assert snap["t_lat_seconds"]["samples"][0]["labels"] == {"pool": "native"}
+
+
+def test_gauge_callback_failure_is_nan_not_crash():
+    reg = Registry()
+
+    def boom():
+        raise RuntimeError("dead service")
+
+    reg.gauge("g_live").set_fn(boom)
+    assert np.isnan(reg.scalars()["g_live"])
+    assert "NaN" in reg.prometheus_text()
+
+
+# ------------------------------------------------------------------ exporter
+def test_exporter_serves_text_json_health_and_404():
+    reg = Registry()
+    reg.counter("exp_total").inc(5)
+    ex = obs.MetricsExporter(reg, port=0)
+    try:
+        base = f"http://127.0.0.1:{ex.port}"
+        text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "exp_total 5" in text
+        snap = json.loads(
+            urllib.request.urlopen(f"{base}/metrics.json").read()
+        )
+        assert snap["exp_total"]["samples"][0]["value"] == 5.0
+        assert (
+            urllib.request.urlopen(f"{base}/healthz").read() == b"ok\n"
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope")
+    finally:
+        ex.stop()
+
+
+def test_start_exporter_is_a_process_singleton():
+    first = obs.start_exporter(0)
+    try:
+        assert obs.start_exporter(0) is first
+        assert obs.current_exporter() is first
+    finally:
+        obs.stop_exporter()
+    assert obs.current_exporter() is None
+
+
+# ------------------------------------------------------------ flight recorder
+def test_flight_recorder_ring_bound_and_dump(tmp_path):
+    fr = obs.FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record("tick", i=i)
+    events = fr.events()
+    assert len(events) == 4  # bounded ring: oldest fell off
+    assert [e["i"] for e in events] == [6, 7, 8, 9]
+    assert fr.recorded_total == 10
+    assert all(
+        {"kind", "t_wall", "t_mono", "seq", "thread"} <= set(e) for e in events
+    )
+    path = str(tmp_path / "sub" / "flight.jsonl")  # dir created on demand
+    assert fr.dump(path) == path
+    lines = [json.loads(l) for l in open(path)]
+    assert [e["i"] for e in lines] == [6, 7, 8, 9]
+    # No installed path and no argument: dump is a no-op, not a crash.
+    assert obs.FlightRecorder().dump() is None
+
+
+def test_flight_event_goes_to_process_recorder():
+    fr = obs.get_flight_recorder()
+    before = fr.recorded_total
+    obs.flight_event("unit_test_marker", x=1)
+    assert fr.recorded_total == before + 1
+    assert fr.events()[-1]["kind"] == "unit_test_marker"
+
+
+# ------------------------------------------------------------------ watchdog
+def _watchdog(**kw):
+    return obs.DivergenceWatchdog(
+        obs.WatchdogConfig(**kw),
+        registry=Registry(),
+        recorder=obs.FlightRecorder(),
+    )
+
+
+def test_watchdog_trips_on_nan_and_inf():
+    wd = _watchdog()
+    wd.check(1, {"critic_loss": 0.5, "grad_norm": 1.0})  # finite: no trip
+    with pytest.raises(obs.DivergenceError, match="non-finite"):
+        wd.check(2, {"critic_loss": float("nan")})
+    with pytest.raises(obs.DivergenceError, match="non-finite"):
+        wd.check(3, {"q_mean": float("inf")})
+
+
+def test_watchdog_trips_on_norm_thresholds_and_records_flight():
+    rec = obs.FlightRecorder()
+    wd = obs.DivergenceWatchdog(
+        obs.WatchdogConfig(grad_norm_max=10.0, param_norm_max=100.0),
+        registry=Registry(),
+        recorder=rec,
+    )
+    wd.check(1, {"grad_norm": 9.9, "param_norm": 99.0})
+    with pytest.raises(obs.DivergenceError, match="grad_norm"):
+        wd.check(2, {"grad_norm": 11.0})
+    with pytest.raises(obs.DivergenceError, match="param_norm"):
+        wd.check(3, {"param_norm": 101.0})
+    kinds = [e["kind"] for e in rec.events()]
+    assert kinds.count("watchdog_trip") == 2
+    err = None
+    try:
+        wd.check(4, {"critic_loss": float("nan")})
+    except obs.DivergenceError as e:
+        err = e
+    assert err is not None and err.step == 4
+    # The trip event's scalars must be JSON-able even with NaN inside.
+    json.dumps(rec.events()[-1])
+
+
+# ----------------------------------------------------------- profiling.timed
+def test_timed_feeds_histograms_and_windows():
+    """utils.profiling.timed accepts anything with .add — both the raw
+    PercentileWindow and an obs Histogram (the hybrid trainer's host-step
+    timing uses it against a registry histogram)."""
+    from r2d2dpg_tpu.utils.profiling import timed
+
+    h = Registry().histogram("timed_seconds")
+    w = PercentileWindow()
+    with timed(h):
+        pass
+    with timed(w):
+        pass
+    assert h.count == 1 and h.total >= 0.0
+    assert w.count == 1
+
+
+# ------------------------------------------------- PercentileWindow edge cases
+def test_percentile_window_of_one():
+    w = PercentileWindow(size=1)
+    w.add(3.0)
+    w.add(7.0)  # evicts 3.0
+    assert w.percentiles((0.0, 50.0, 100.0)) == (7.0, 7.0, 7.0)
+    count, total, p50, p99 = w.snapshot()
+    assert count == 2  # lifetime count survives eviction
+    assert total == 10.0  # lifetime total too
+    assert p50 == 7.0 and p99 == 7.0
+
+
+def test_percentile_window_q0_and_q100_nearest_rank():
+    w = PercentileWindow(size=8)
+    for v in (5.0, 1.0, 3.0, 2.0, 4.0):
+        w.add(v)
+    # Nearest-rank: q=0 clamps to the minimum, q=100 is the maximum.
+    assert w.percentiles((0.0,)) == (1.0,)
+    assert w.percentiles((100.0,)) == (5.0,)
+    assert w.percentiles((50.0,)) == (3.0,)
+    # Empty window: zeros, not an exception.
+    assert PercentileWindow().percentiles((0.0, 100.0)) == (0.0, 0.0)
+    assert PercentileWindow().snapshot() == (0, 0.0, 0.0, 0.0)
+
+
+def test_percentile_window_eviction_past_maxlen():
+    w = PercentileWindow(size=4)
+    for v in range(10):  # 0..9; window keeps 6,7,8,9
+        w.add(float(v))
+    assert w.percentiles((0.0, 100.0)) == (6.0, 9.0)
+    count, total, p50, p99 = w.snapshot()
+    assert count == 10 and total == 45.0  # lifetime, not windowed
+    assert p50 == 7.0 and p99 == 9.0
+    w.reset()
+    assert w.snapshot() == (0, 0.0, 0.0, 0.0)
+
+
+def test_percentile_window_invalid_size():
+    with pytest.raises(ValueError):
+        PercentileWindow(size=0)
+
+
+# ------------------------------------------------------- MetricLogger: threads
+def test_metric_logger_two_thread_hammer(tmp_path):
+    """The pipelined executor's learner thread and the serving health
+    logger interleave log() calls; without the lock this corrupted the
+    CSV writer state (satellite #1)."""
+    logdir = str(tmp_path / "hammer")
+    log = MetricLogger(logdir, stdout=False, tensorboard=False)
+    n, errs = 200, []
+
+    def worker(tag):
+        try:
+            for i in range(n):
+                row = {f"{tag}": float(i)}
+                if i == 50:  # force a mid-run header change per thread
+                    row[f"{tag}_extra"] = 1.0
+                log.log(i, row)
+                log.rates(**{f"{tag}_count": float(i)})
+        except Exception as e:  # pragma: no cover - the failure under test
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in ("a", "b")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    log.close()
+    assert not errs
+    with open(os.path.join(logdir, "metrics.csv")) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 2 * n
+    fields = set(rows[-1].keys())
+    assert {"a", "b", "a_extra", "b_extra"} <= fields
+
+
+# -------------------------------------------------- MetricLogger: append-only
+def test_metric_logger_appends_without_rewrite(tmp_path, monkeypatch):
+    """satellite #2: the CSV is rewritten ONLY when the header changes;
+    steady-state logging appends (the old code re-read + re-wrote the whole
+    file on every (re)open — O(rows^2) over a long run)."""
+    logdir = str(tmp_path / "run")
+    calls = []
+    orig = MetricLogger._reopen_csv
+    monkeypatch.setattr(
+        MetricLogger,
+        "_reopen_csv",
+        lambda self, row: (calls.append(1), orig(self, row))[1],
+    )
+    with MetricLogger(logdir, stdout=False, tensorboard=False) as log:
+        for i in range(50):
+            log.log(i, {"a": float(i)})
+        assert len(calls) == 1  # first open only
+        log.log(50, {"a": 1.0, "b": 2.0})  # header change: one rewrite
+        assert len(calls) == 2
+        for i in range(51, 60):
+            log.log(i, {"a": 1.0, "b": 2.0})
+        assert len(calls) == 2  # steady state: appends
+
+    csv_path = os.path.join(logdir, "metrics.csv")
+    # Plant a text marker a rewrite would normalize away ("2.0" -> "2.00"):
+    # a resume that APPENDS must leave the existing bytes untouched.
+    content = open(csv_path).read()
+    open(csv_path, "w").write(content.replace("2.0", "2.00", 1))
+    with MetricLogger(logdir, stdout=False, tensorboard=False) as log:
+        log.log(60, {"a": 9.0, "b": 9.0})
+    assert "2.00" in open(csv_path).read()
+    rows = list(csv.DictReader(open(csv_path)))
+    assert len(rows) == 61 and rows[-1]["a"] == "9.0"
+
+
+def test_metric_logger_registry_bridge(tmp_path):
+    """Registry scalars fold into rows as EXTRA columns; explicit scalars
+    win name collisions, so the canonical curves are unchanged."""
+    reg = Registry()
+    reg.gauge("bridge_gauge").set(5.0)
+    reg.counter("episode_return_mean").inc(99)  # collides with a real key
+    logdir = str(tmp_path / "run")
+    with MetricLogger(
+        logdir, stdout=False, tensorboard=False, registry=reg
+    ) as log:
+        log.log(1, {"episode_return_mean": 1.5})
+    rows = list(csv.DictReader(open(os.path.join(logdir, "metrics.csv"))))
+    assert rows[0]["bridge_gauge"] == "5.0"
+    assert rows[0]["episode_return_mean"] == "1.5"  # explicit key won
+
+
+# ------------------------------------------------------------------ lint gate
+def test_lint_obs_clean():
+    """scripts/lint_obs.sh: no bare print( in library code (CLI
+    entrypoints and annotated sinks excepted)."""
+    res = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "lint_obs.sh")],
+        capture_output=True,
+        text=True,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_lint_obs_catches_offender(tmp_path):
+    """The gate actually bites: a copy of the tree with a bare print(
+    planted in library code must fail."""
+    import shutil
+
+    tree = tmp_path / "repo"
+    (tree / "scripts").mkdir(parents=True)
+    shutil.copy(
+        os.path.join(REPO, "scripts", "lint_obs.sh"), tree / "scripts"
+    )
+    pkg = tree / "r2d2dpg_tpu"
+    pkg.mkdir()
+    (pkg / "offender.py").write_text('print("operator-invisible")\n')
+    res = subprocess.run(
+        ["bash", str(tree / "scripts" / "lint_obs.sh")],
+        capture_output=True,
+        text=True,
+    )
+    assert res.returncode == 1
+    assert "offender.py" in res.stdout
+
+
+# ------------------------------------------------------- serving integration
+def test_health_snapshot_publish_refits_onto_registry():
+    from r2d2dpg_tpu.serving.health import HealthSnapshot
+
+    reg = Registry()
+    snap = HealthSnapshot(
+        queue_depth=3,
+        batch_occupancy=0.5,
+        latency_p50_ms=1.0,
+        latency_p99_ms=2.0,
+        step_p50_ms=0.5,
+        step_p99_ms=0.9,
+        params_step=17,
+        params_staleness_s=4.0,
+        requests_ok=100,
+        requests_shed=2,
+        sessions_active=5,
+        sessions_evicted=1,
+    )
+    snap.publish(reg)
+    scalars = reg.scalars()
+    assert scalars["r2d2dpg_serving_queue_depth"] == 3.0
+    assert scalars["r2d2dpg_serving_params_step"] == 17.0
+    # Every as_scalars field made it across.
+    for k in snap.as_scalars():
+        assert f"r2d2dpg_serving_{k}" in scalars
+
+
+# ------------------------------------------------------ env-pool integration
+def test_host_pool_step_registers_envpool_instruments():
+    """The dm_control fleet feeds the pool="python" label set: step
+    latency + lock-wait histograms and the resets counter all move.
+    Instrument registration is asserted unconditionally (it happens at
+    pool construction); the stepping assertions skip when this container
+    cannot load dm_control physics (no EGL — a known environment gap)."""
+    pytest.importorskip("dm_control")
+    from r2d2dpg_tpu.envs.dmc_host import _HostPool
+
+    reg = obs.get_registry()
+    pool = _HostPool("walker", "walk", pixels=False, camera_id=0)
+    step_h = reg.get("r2d2dpg_envpool_step_seconds").labels(pool="python")
+    lock_h = reg.get("r2d2dpg_envpool_lock_wait_seconds").labels(
+        pool="python"
+    )
+    assert reg.get("r2d2dpg_envpool_resets_total") is not None
+    try:
+        pool.reset_all(np.arange(2))
+    except Exception as e:  # pragma: no cover - container-dependent
+        pytest.skip(f"dm_control env unavailable here: {type(e).__name__}")
+    before = step_h.count
+    for _ in range(3):
+        pool.step_all(np.zeros((2, 6), np.float32))
+    assert step_h.count == before + 3
+    assert lock_h.count >= 3
+    text = reg.prometheus_text()
+    assert 'r2d2dpg_envpool_step_seconds_count{pool="python"}' in text
+
+
+def test_host_pool_step_instruments_move_with_stub_envs():
+    """Container-independent: drive _HostPool.step_all over stub envs (no
+    dm_control physics) and watch the step/lock/reset instruments move."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from r2d2dpg_tpu.envs.dmc_host import _HostPool
+
+    class _Obs(dict):
+        pass
+
+    class _Ts:
+        def __init__(self, last):
+            self.reward = 0.5
+            self.discount = 1.0
+            self.observation = _Obs(x=np.zeros(3, np.float32))
+            self._last = last
+
+        def last(self):
+            return self._last
+
+    class _StubEnv:
+        def __init__(self):
+            self.n = 0
+
+        def step(self, action):
+            self.n += 1
+            return _Ts(last=(self.n % 2 == 0))  # every 2nd step ends
+
+        def reset(self):
+            return _Ts(last=False)
+
+    pool = _HostPool("walker", "walk", pixels=False, camera_id=0)
+    pool.envs = [_StubEnv(), _StubEnv()]
+    pool.executor = ThreadPoolExecutor(max_workers=2)
+    reg = obs.get_registry()
+    step_h = reg.get("r2d2dpg_envpool_step_seconds").labels(pool="python")
+    resets = reg.get("r2d2dpg_envpool_resets_total").labels(pool="python")
+    s0, r0 = step_h.count, resets.value
+    for _ in range(4):
+        out = pool.step_all(np.zeros((2, 1), np.float32))
+    assert len(out) == 4
+    assert step_h.count == s0 + 4
+    # Stub episodes end every 2nd step: 2 envs x 2 boundary steps = 4.
+    assert resets.value == r0 + 4.0
+    pool.executor.shutdown(wait=False)
+
+
+# ------------------------------------------------------- trainer integration
+def test_train_run_with_obs_port_exposes_trainer_and_replay(tmp_path):
+    """--obs-port: a phase-locked run registers trainer + replay
+    instruments and the exporter serves them as Prometheus text + JSON."""
+    from r2d2dpg_tpu.train import parse_args, run
+
+    obs.stop_exporter()  # a fresh singleton for this test
+    logdir = str(tmp_path / "log")
+    args = parse_args(
+        [
+            "--config", "pendulum_tiny",
+            "--phases", "2",
+            "--log-every", "1",
+            "--logdir", logdir,
+            "--obs-port", "0",
+        ]
+    )
+    try:
+        run(args)
+        port = int(open(os.path.join(logdir, "obs_port.txt")).read())
+        text = (
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics")
+            .read()
+            .decode()
+        )
+        for family in (
+            "r2d2dpg_trainer_env_steps",
+            "r2d2dpg_trainer_learner_steps",
+            "r2d2dpg_trainer_episodes_total",
+            "r2d2dpg_replay_occupancy",
+            "r2d2dpg_replay_priority_sum",
+            "r2d2dpg_watchdog_checks_total",
+        ):
+            assert family in text, family
+        snap = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics.json"
+            ).read()
+        )
+        assert snap["r2d2dpg_replay_occupancy"]["samples"][0]["value"] > 0
+        # The CSV bridge folded registry columns into the rows.
+        rows = list(
+            csv.DictReader(open(os.path.join(logdir, "metrics.csv")))
+        )
+        assert "r2d2dpg_trainer_env_steps" in rows[-1]
+        assert "episode_return_mean" in rows[-1]  # curves unchanged
+    finally:
+        obs.stop_exporter()
+
+
+def test_nan_injection_trips_watchdog_dumps_flight_and_exits_nonzero(
+    tmp_path,
+):
+    """Acceptance: a forced NaN in a learner update trips the watchdog,
+    writes flight.jsonl with the recent event ring, points at the last
+    good checkpoint, and exits non-zero — end to end through the CLI."""
+    from r2d2dpg_tpu.train import parse_args, run
+
+    logdir = str(tmp_path / "log")
+    ckdir = str(tmp_path / "ck")
+    args = parse_args(
+        [
+            "--config", "pendulum_tiny",
+            "--phases", "4",
+            "--log-every", "1",
+            "--logdir", logdir,
+            "--checkpoint-dir", ckdir,
+            "--checkpoint-every", "1",
+            "--nan-inject-phase", "2",
+        ]
+    )
+    with pytest.raises(SystemExit) as exc:
+        run(args)
+    assert exc.value.code == 2
+    flight_path = os.path.join(logdir, "flight.jsonl")
+    assert os.path.exists(flight_path)
+    events = [json.loads(l) for l in open(flight_path)]
+    kinds = [e["kind"] for e in events]
+    assert "watchdog_trip" in kinds
+    assert "abort" in kinds
+    assert "checkpoint_save" in kinds  # the ring kept the save trail
+    trip = next(e for e in events if e["kind"] == "watchdog_trip")
+    assert "non-finite" in trip["reason"]
+    # A checkpoint exists on disk to resume from (the pointer target).
+    from r2d2dpg_tpu.utils import CheckpointManager
+
+    ck = CheckpointManager(ckdir)
+    assert ck.latest_step is not None
+    ck.close()
+
+
+def test_watchdog_off_flag_does_not_trip(tmp_path):
+    from r2d2dpg_tpu.train import parse_args, run
+
+    args = parse_args(
+        [
+            "--config", "pendulum_tiny",
+            "--phases", "3",
+            "--log-every", "1",
+            "--logdir", str(tmp_path / "log"),
+            "--nan-inject-phase", "1",
+            "--watchdog", "0",
+        ]
+    )
+    final = run(args)  # completes despite the poison: no watchdog
+    assert any(np.isnan(v) for v in final.values() if isinstance(v, float))
+
+
+def test_pipeline_refuses_nan_injection():
+    from r2d2dpg_tpu.train import parse_args, run
+
+    args = parse_args(
+        [
+            "--config", "pendulum_tiny",
+            "--phases", "1",
+            "--pipeline", "1",
+            "--nan-inject-phase", "1",
+        ]
+    )
+    with pytest.raises(SystemExit, match="nan-inject"):
+        run(args)
