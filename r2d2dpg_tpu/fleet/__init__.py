@@ -1,0 +1,39 @@
+"""Actor fleet (ISSUE 4): supervised out-of-process actors + experience
+ingest feeding the learner's staging queue.
+
+The Ape-X/R2D2 topology (PAPERS.md 1803.00933) grafted onto the Anakin
+core: N actor subprocesses each own an env pool and a stale net copy,
+rank fresh sequences locally, and stream ``replay.StagedSequences`` over
+a CRC-checked framed protocol to the learner's ingest server, which
+drains them through the SAME ``ReplayArena.add_staged`` path the
+in-process pipelined executor uses.  ``fleet=off`` (``--actors 0``) is
+the untouched phase-locked schedule, pinned bit-identical by
+tests/test_fleet.py.
+
+- ``transport``  — length-prefixed CRC32 frames over TCP/Unix sockets.
+- ``actor``      — the worker-process collect loop + per-actor noise
+  ladder slice (``python -m r2d2dpg_tpu.fleet.actor``).
+- ``ingest``     — ``IngestServer`` (N connections -> staging queue) and
+  ``FleetLearner`` (the queue's single consumer: drain -> add -> learn).
+- ``supervisor`` — spawn/monitor/restart-with-backoff for the actor
+  subprocesses; crashes land in the flight recorder.
+
+See docs/FLEET.md for the wire protocol, backpressure/shed contract,
+noise-ladder mapping, and determinism anchor.
+"""
+
+from r2d2dpg_tpu.fleet.ingest import FleetConfig, FleetLearner, IngestServer
+from r2d2dpg_tpu.fleet.supervisor import (
+    ActorSupervisor,
+    SupervisorConfig,
+    default_actor_argv,
+)
+
+__all__ = [
+    "ActorSupervisor",
+    "FleetConfig",
+    "FleetLearner",
+    "IngestServer",
+    "SupervisorConfig",
+    "default_actor_argv",
+]

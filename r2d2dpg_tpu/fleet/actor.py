@@ -1,0 +1,429 @@
+"""Fleet actor: an out-of-process collector streaming experience upstream.
+
+One actor subprocess owns its env pool (``--num-envs`` lanes of the
+vmapped batch, or a host dm_control pool) and a stale copy of the
+learner's nets, runs the R2D2-DPG rollout, computes initial priorities
+locally with those stale nets (Ape-X §3: sequences enter replay already
+ranked), and streams one ``replay.StagedSequences`` batch per collect
+phase to the learner's ingest server — applying versioned param updates
+between phases and ignoring regressions (a delayed PARAMS frame must
+never roll the policy backwards).
+
+Exploration: Ape-X gives actor ``i`` of ``N`` its own epsilon
+(1803.00933 §D); the DPG analogue is this repo's sigma ladder
+(``ops/noise.py``).  In-process the "actors" are env lanes, so the ladder
+spans ``num_envs``; in a fleet it spans the GLOBAL ``num_actors *
+num_envs`` lanes and each actor slices its contiguous block —
+``FleetActorTrainer._local_sigmas`` below, the same slicing contract as
+``SPMDTrainer``'s per-device shards.  A 3-actor pendulum fleet explores
+exactly like one 3x-wider in-process batch.
+
+CLI (spawned by ``fleet/supervisor.py``; runnable by hand for debugging):
+
+    python -m r2d2dpg_tpu.fleet.actor --config pendulum_tiny \\
+        --connect 127.0.0.1:7450 --actor-id 0 --num-actors 3 --seed 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from r2d2dpg_tpu.configs import CONFIGS, ExperimentConfig, get_config
+from r2d2dpg_tpu.fleet.transport import (
+    K_ACK,
+    K_BYE,
+    K_HELLO,
+    K_PARAMS,
+    K_SEQS,
+    FrameError,
+    connect,
+    pack_obj,
+    recv_frame,
+    send_frame,
+    to_host,
+    unpack_obj,
+)
+from r2d2dpg_tpu.obs import flight_event, get_registry, set_flight_identity
+from r2d2dpg_tpu.ops import sigma_ladder
+from r2d2dpg_tpu.replay.arena import StagedSequences
+from r2d2dpg_tpu.training.assembler import emit
+from r2d2dpg_tpu.training.pipeline import CollectorState, split_state
+from r2d2dpg_tpu.training.trainer import Trainer, TrainerConfig
+from r2d2dpg_tpu.utils.codes import SHED_INGEST
+
+
+class FleetActorTrainer(Trainer):
+    """A ``Trainer`` whose noise ladder is one actor's slice of the fleet's.
+
+    Everything else (collect scan, window assembler, episode accounting)
+    is the base trainer verbatim — the actor IS a collector, just living
+    in its own process with ``num_envs`` local lanes of a
+    ``num_actors * num_envs``-lane fleet."""
+
+    def __init__(
+        self,
+        env,
+        agent,
+        config: TrainerConfig,
+        *,
+        actor_index: int,
+        num_actors: int,
+    ):
+        if not 0 <= actor_index < num_actors:
+            raise ValueError(
+                f"actor_index {actor_index} outside fleet of {num_actors}"
+            )
+        self.actor_index = actor_index
+        self.num_actors = num_actors
+        super().__init__(env, agent, config)
+
+    def _local_sigmas(self) -> jnp.ndarray:
+        sigmas = sigma_ladder(
+            self.num_actors * self.config.num_envs,
+            sigma_max=self.config.sigma_max,
+            alpha=self.config.ladder_alpha,
+            kind=self.config.ladder_kind,
+        )
+        lo = self.actor_index * self.config.num_envs
+        return sigmas[lo : lo + self.config.num_envs]
+
+
+def build_actor_trainer(
+    exp: ExperimentConfig, *, actor_index: int, num_actors: int
+) -> FleetActorTrainer:
+    """The actor's trainer: full net/agent recipe, TINY arena (the actor
+    never samples — replay lives learner-side; allocating the config's
+    full capacity here would burn host RAM per actor for buffers that
+    only ever hold ``init_state`` zeros)."""
+    env = exp.env_factory()
+    agent = exp.build_agent(env)
+    tcfg = dataclasses.replace(
+        exp.trainer, capacity=max(exp.trainer.num_envs, 1), min_replay=1
+    )
+    return FleetActorTrainer(
+        env, agent, tcfg, actor_index=actor_index, num_actors=num_actors
+    )
+
+
+class FleetActor:
+    """The worker loop: collect -> rank -> stream -> apply params."""
+
+    def __init__(
+        self,
+        exp: ExperimentConfig,
+        *,
+        actor_id: int,
+        num_actors: int,
+        address: str,
+        seed: Optional[int] = None,
+    ):
+        self.actor_id = actor_id
+        self.address = address
+        self.trainer = build_actor_trainer(
+            exp, actor_index=actor_id, num_actors=num_actors
+        )
+        t = self.trainer
+        seed = t.config.seed if seed is None else seed
+        # Distinct stream per actor: same base seed, folded actor index —
+        # a fleet at seed S is a different (equally valid) trajectory per
+        # actor, never N copies of one rollout.
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), actor_id)
+        state = t.init(key)
+        self._cstate, lstate = split_state(state)
+        # The stale learner-net copy: acts AND ranks until the first
+        # PARAMS frame lands (version 0 = own init).
+        self._train = lstate.train
+        self._param_version = 0
+        self._sheds = 0
+        self._phase = 0
+        self._last_env_steps = 0.0  # for per-phase deltas (see run)
+        self._warm_prog = jax.jit(
+            lambda cs, behavior, critic: t._collect(
+                cs, behavior=behavior, critic_params=critic
+            ),
+            donate_argnums=(0,),
+        )
+        self._collect_prog = jax.jit(self._collect_emit, donate_argnums=(0,))
+        self._local_priorities = (
+            t.config.prioritized and t.config.initial_priority == "td"
+        )
+        if self._local_priorities:
+            self._prio_prog = jax.jit(t.agent.initial_priority)
+        reg = get_registry()
+        self._obs_phases = reg.counter(
+            "r2d2dpg_actor_phases_total", "collect phases completed"
+        )
+        self._obs_shed = reg.counter(
+            "r2d2dpg_actor_shed_total", "batches the ingest server shed"
+        )
+        self._obs_version = reg.gauge(
+            "r2d2dpg_actor_param_version", "last applied param version"
+        )
+
+    # ---------------------------------------------------------- device parts
+    def _collect_emit(self, cstate: CollectorState, behavior, critic):
+        cstate = self.trainer._collect(
+            cstate, behavior=behavior, critic_params=critic
+        )
+        return cstate, emit(cstate.window)
+
+    # -------------------------------------------------------------- params
+    def maybe_apply_params(self, msg: Any) -> bool:
+        """Apply a versioned snapshot; IGNORE stale or replayed versions.
+
+        The regression guard: acks/pushes can interleave across a
+        reconnect, and a policy must only ever move forward — an actor
+        that applied version 7 then saw a delayed 5 would collect with
+        nets the learner has already trained past twice over."""
+        version = int(msg["version"])
+        if version <= self._param_version:
+            flight_event(
+                "param_regression_ignored",
+                got=version,
+                have=self._param_version,
+            )
+            return False
+        # device_put ONCE at apply time: leaving numpy leaves in _train
+        # would re-upload the whole param set on every jitted collect call.
+        p = jax.device_put(msg["params"])
+        self._train = dataclasses.replace(
+            self._train,
+            actor_params=p["actor_params"],
+            critic_params=p["critic_params"],
+            target_actor_params=p["target_actor_params"],
+            target_critic_params=p["target_critic_params"],
+        )
+        self._param_version = version
+        self._obs_version.set(float(version))
+        return True
+
+    # ------------------------------------------------------------ one phase
+    def collect_phase(self) -> Optional[StagedSequences]:
+        """One stride of env steps; returns the emitted batch (None during
+        window warm-up, when the window still contains init padding)."""
+        behavior = self._train.actor_params
+        critic = self.trainer.agent.behavior_critic_params(self._train)
+        if self._phase < self.trainer.window_fill_phases:
+            self._cstate = self._warm_prog(self._cstate, behavior, critic)
+            self._phase += 1
+            self._obs_phases.inc()
+            return None
+        self._cstate, seq = self._collect_prog(self._cstate, behavior, critic)
+        self._phase += 1
+        self._obs_phases.inc()
+        prios = (
+            self._prio_prog(self._train, seq)
+            if self._local_priorities
+            else None
+        )
+        return StagedSequences(seq=seq, priorities=prios)
+
+    def _pop_episode_stats(self):
+        """Drain the device accumulators (refs leave ``_cstate`` before the
+        next donating collect call — the pipeline collector's discipline)."""
+        cs = self._cstate
+        refs = (jnp.copy(cs.env_steps), cs.completed_return_sum, cs.completed_count)
+        self._cstate = dataclasses.replace(
+            cs,
+            completed_return_sum=jnp.zeros(()),
+            completed_count=jnp.zeros(()),
+        )
+        return refs
+
+    # ------------------------------------------------------------------ run
+    def run(self, max_phases: Optional[int] = None) -> None:
+        """Stream until the server goes away (orderly end) or a protocol
+        error surfaces (crash — nonzero exit, the supervisor restarts)."""
+        sock = connect(self.address)
+        try:
+            send_frame(
+                sock,
+                K_HELLO,
+                pack_obj(
+                    {
+                        "actor_id": self.actor_id,
+                        "num_envs": self.trainer.config.num_envs,
+                    }
+                ),
+            )
+            self._await_ack(sock)
+            while max_phases is None or self._phase < max_phases:
+                staged = self.collect_phase()
+                if staged is None:
+                    continue  # warm-up: window not yet real
+                # ONE batched device fetch per phase (episode stats + the
+                # staged pytree + priorities) — the pop_episode_metrics
+                # lesson; separate fetches would be three host syncs on
+                # every actor's critical path.  None priorities pass
+                # through device_get as an empty subtree.
+                (env_steps, ret_sum, count), seq_host, prios_host = (
+                    jax.device_get(
+                        (
+                            self._pop_episode_stats(),
+                            staged.seq,
+                            staged.priorities,
+                        )
+                    )
+                )
+                # DELTAS, not cumulative: a supervised restart resets this
+                # process, and the learner's fleet-wide sums must stay
+                # monotone across incarnations (ingest just accumulates).
+                steps_delta = float(env_steps) - self._last_env_steps
+                self._last_env_steps = float(env_steps)
+                payload = pack_obj(
+                    {
+                        "phase": self._phase,
+                        "param_version": self._param_version,
+                        "env_steps_delta": steps_delta,
+                        "ep_return_sum": float(ret_sum),
+                        "ep_count": float(count),
+                        "staged": StagedSequences(
+                            seq=seq_host, priorities=prios_host
+                        ),
+                    }
+                )
+                send_frame(sock, K_SEQS, payload)
+                ack = self._await_ack(sock)
+                if ack["code"] == SHED_INGEST:
+                    self._sheds += 1
+                    self._obs_shed.inc()
+            try:
+                send_frame(sock, K_BYE, b"")
+            except OSError:
+                pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _await_ack(self, sock) -> Any:
+        """Read to the next ACK, applying any PARAMS pushed ahead of it
+        (the server orders PARAMS-then-ACK so a fresh snapshot is live
+        before the next collect phase)."""
+        while True:
+            kind, payload = recv_frame(sock)
+            if kind == K_PARAMS:
+                self.maybe_apply_params(unpack_obj(payload))
+                continue
+            if kind == K_ACK:
+                return unpack_obj(payload)
+            if kind == K_BYE:
+                raise _OrderlyShutdown()
+            raise FrameError(f"unexpected frame kind {kind}")
+
+
+class _OrderlyShutdown(Exception):
+    """Server said BYE mid-stream: exit 0, nothing crashed."""
+
+
+# ---------------------------------------------------------------------- CLI
+def structural_argv(exp: ExperimentConfig):
+    """The actor flags that must MIRROR the learner's resolved config —
+    net/param-tree structure (a mismatched tree crash-loops every actor)
+    and the exploration ladder.  THE single source for the spawner
+    (train.py forwards exactly this); a new structural knob is added here
+    plus the parser/_apply_overrides below, never hand-copied into
+    spawners."""
+    return [
+        "--num-envs", str(exp.trainer.num_envs),
+        "--n-step", str(exp.agent.n_step),
+        "--twin-critic", "1" if exp.agent.twin_critic else "0",
+        "--sigma-max", str(exp.trainer.sigma_max),
+        "--ladder-alpha", str(exp.trainer.ladder_alpha),
+        "--compute-dtype", exp.compute_dtype,
+    ]
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="python -m r2d2dpg_tpu.fleet.actor", description=__doc__
+    )
+    p.add_argument("--config", required=True, choices=sorted(CONFIGS))
+    p.add_argument("--connect", required=True, help="ingest server address")
+    p.add_argument("--actor-id", type=int, required=True)
+    p.add_argument("--num-actors", type=int, required=True)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--phases", type=int, default=None,
+                   help="stop after this many collect phases (default: "
+                   "stream until the server disconnects)")
+    # Structural/exploration overrides — must match the learner's so the
+    # published param trees fit the actor's nets (train.py forwards them).
+    p.add_argument("--num-envs", type=int, default=None)
+    p.add_argument("--n-step", type=int, default=None)
+    p.add_argument("--twin-critic", type=int, default=None, choices=[0, 1])
+    p.add_argument("--sigma-max", type=float, default=None)
+    p.add_argument("--ladder-alpha", type=float, default=None)
+    p.add_argument("--compute-dtype", default=None,
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--flight-path", default=None,
+                   help="dump this actor's flight ring here on exit")
+    return p.parse_args(argv)
+
+
+def _apply_overrides(exp: ExperimentConfig, args) -> ExperimentConfig:
+    t = {
+        k: getattr(args, k)
+        for k in ("num_envs", "sigma_max", "ladder_alpha", "seed")
+        if getattr(args, k) is not None
+    }
+    if t:
+        exp = dataclasses.replace(
+            exp, trainer=dataclasses.replace(exp.trainer, **t)
+        )
+    a = {}
+    if args.n_step is not None:
+        a["n_step"] = args.n_step
+    if args.twin_critic is not None:
+        a["twin_critic"] = bool(args.twin_critic)
+    if a:
+        exp = dataclasses.replace(
+            exp, agent=dataclasses.replace(exp.agent, **a)
+        )
+    if args.compute_dtype is not None:
+        exp = dataclasses.replace(exp, compute_dtype=args.compute_dtype)
+    return exp
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    set_flight_identity(actor=args.actor_id)
+    if args.flight_path:
+        from r2d2dpg_tpu.obs import get_flight_recorder
+
+        get_flight_recorder().install(args.flight_path)
+    exp = _apply_overrides(get_config(args.config), args)
+    actor = FleetActor(
+        exp,
+        actor_id=args.actor_id,
+        num_actors=args.num_actors,
+        address=args.connect,
+        seed=args.seed,
+    )
+    flight_event("actor_start", phase=0, address=args.connect)
+    try:
+        actor.run(max_phases=args.phases)
+    except _OrderlyShutdown:
+        # The server said BYE: the learner is done — exit 0, nothing broke.
+        flight_event("actor_disconnect", phase=actor._phase)
+    except (FrameError, OSError) as e:
+        # Anything else — refused connect, CRC violation, torn stream — is
+        # a CRASH per this module's contract: record the actual error
+        # (flight ring + stderr, which the supervisor routes to the
+        # per-actor log) and exit nonzero so the supervisor restarts us.
+        err = f"{type(e).__name__}: {e}"
+        flight_event("actor_conn_lost", phase=actor._phase, error=err)
+        raise SystemExit(
+            f"fleet actor {args.actor_id}: connection lost at phase "
+            f"{actor._phase}: {err}"
+        )
+    flight_event("actor_exit", phase=actor._phase, sheds=actor._sheds)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,661 @@
+"""Learner-side experience ingest: N actor connections -> one staging queue.
+
+The Ape-X topology's center (PAPERS.md 1803.00933): out-of-process actors
+stream ``replay.StagedSequences`` batches over the fleet wire protocol
+(``fleet/transport.py``); this server reassembles them onto the SAME
+bounded staging queue / ``ReplayArena.add_staged`` path the in-process
+pipelined executor uses (``training/pipeline.py``), so fleet experience
+enters the arena through the exact drain program local experience does.
+
+Per-connection protocol (one handler thread per actor; that thread is the
+connection's ONLY writer, so acks and param pushes never interleave):
+
+    actor                          ingest handler
+    -----                          --------------
+    HELLO {actor_id}          ->
+                              <-   [PARAMS {version, params}]   (if any)
+                              <-   ACK {code: ok, param_version}
+    SEQS {staged, stats}      ->   staging_queue.put (bounded wait)
+                              <-   [PARAMS]     (actor's version is stale)
+                              <-   ACK {code: ok | shed_ingest_queue_full}
+    ...
+    BYE                       ->   (or either side just closes)
+
+Backpressure/shed contract: the actor blocks on the ACK, so it has at most
+one unacknowledged batch in flight; the handler waits ``shed_after_s`` for
+queue room and then **sheds loudly** — ``SHED_INGEST`` ack (the actor
+counts and keeps collecting), a ``shed`` flight-recorder event, and the
+per-actor shed counter.  Experience is the one payload that may be dropped
+under pressure: fresher experience is already behind it.
+
+The drain side (``FleetLearner``) runs on the caller's thread and is the
+staging queue's single consumer — the single-writer contract
+``ReplayArena.add_staged`` enforces (docs/FLEET.md "Single writer").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from r2d2dpg_tpu.fleet import transport
+from r2d2dpg_tpu.fleet.transport import (
+    K_ACK,
+    K_BYE,
+    K_HELLO,
+    K_PARAMS,
+    K_SEQS,
+    FrameError,
+    pack_obj,
+    recv_frame,
+    send_frame,
+    to_host,
+    unpack_obj,
+)
+from r2d2dpg_tpu.obs import flight_event, get_registry
+from r2d2dpg_tpu.replay.arena import StagedSequences
+from r2d2dpg_tpu.training.pipeline import (
+    LearnerState,
+    drain_staged,
+    merge_state,
+    split_state,
+)
+from r2d2dpg_tpu.training.trainer import Trainer, TrainerState
+from r2d2dpg_tpu.utils.codes import OK, SHED_INGEST
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Static fleet knobs (the trainer's own config governs the rest)."""
+
+    num_actors: int
+    address: str = "127.0.0.1:0"  # "host:port" (0 = ephemeral) | "unix:/path"
+    queue_depth: int = 4  # staging-queue capacity, in staged batches
+    publish_every: int = 1  # drain phases between param publications
+    prefetch: bool = True  # double-buffered sampling in the drain program
+    shed_after_s: float = 1.0  # handler waits this long before shedding
+    idle_timeout_s: float = 300.0  # no batch for this long = starved, abort
+    max_frame_bytes: int = transport.MAX_FRAME_BYTES
+
+
+class IngestServer:
+    """Accepts actor connections and feeds the learner's staging queue."""
+
+    def __init__(
+        self,
+        staging_queue: "queue.Queue",
+        *,
+        address: str = "127.0.0.1:0",
+        shed_after_s: float = 1.0,
+        max_frame_bytes: int = transport.MAX_FRAME_BYTES,
+    ):
+        self.queue = staging_queue
+        self._request_address = address
+        self.shed_after_s = shed_after_s
+        self.max_frame_bytes = max_frame_bytes
+        self.address: Optional[str] = None  # resolved at start()
+        self._unix_path: Optional[str] = None
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._handlers: List[threading.Thread] = []
+        self._conns: Dict[int, socket.socket] = {}  # ident -> live socket
+        self._conn_seq = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        # Latest published params: raw host trees swapped in by the drain
+        # thread (cheap), packed ONCE per version on the first handler push
+        # (_params_snapshot) — neither the drain thread nor later pushes
+        # pay the pickle.
+        self._params_obj: Optional[Any] = None
+        self._params_frame: Optional[bytes] = None
+        self._param_version = 0
+        self.shed_total = 0
+        self.seqs_total = 0
+        # Scalar stats riding a shed SEQS message: the EXPERIENCE may be
+        # dropped under pressure, but the episode/step accounting must not
+        # be (the actor already drained its accumulators) — banked here,
+        # folded back in by the learner (pop_shed_stats).
+        self._shed_stats = {
+            "env_steps_delta": 0.0, "ep_return_sum": 0.0, "ep_count": 0.0,
+        }
+        # Telemetry (obs/): per-actor label sets on shared instruments.
+        reg = get_registry()
+        self._obs_frames = reg.counter(
+            "r2d2dpg_fleet_frames_total",
+            "experience frames received from actors",
+            labelnames=("actor",),
+        )
+        self._obs_seqs = reg.counter(
+            "r2d2dpg_fleet_sequences_total",
+            "sequences received from actors (pre-shed)",
+            labelnames=("actor",),
+        )
+        self._obs_shed = reg.counter(
+            "r2d2dpg_fleet_shed_total",
+            "staged batches shed on a full staging queue",
+            labelnames=("actor",),
+        )
+        self._obs_staleness = reg.gauge(
+            "r2d2dpg_fleet_param_staleness_versions",
+            "published param version minus the actor's last-applied version",
+            labelnames=("actor",),
+        )
+        self._obs_connected = reg.gauge(
+            "r2d2dpg_fleet_actors_connected", "live actor connections"
+        )
+        self._obs_connected.set_fn(lambda: float(len(self._conns)))
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "IngestServer":
+        if self._listener is not None:
+            raise RuntimeError("ingest server already started")
+        family, target = transport.parse_address(self._request_address)
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        if family == socket.AF_INET:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        else:
+            # A previous run's STALE socket file would fail the bind — but
+            # only unlink if nothing answers: blindly unlinking would let a
+            # second run silently steal a live run's ingest address (and
+            # its restarting actors).
+            import os
+
+            if os.path.exists(target):
+                probe = socket.socket(family, socket.SOCK_STREAM)
+                probe.settimeout(0.5)
+                try:
+                    probe.connect(target)
+                except OSError:
+                    os.unlink(target)  # stale: nothing listening
+                else:
+                    raise RuntimeError(
+                        f"ingest address unix:{target} already has a live "
+                        f"server — is another fleet run using it?"
+                    )
+                finally:
+                    probe.close()
+        sock.bind(target)
+        sock.listen(64)
+        if family == socket.AF_INET:
+            host, port = sock.getsockname()[:2]
+            self.address = f"{host}:{port}"
+        else:
+            self.address = f"unix:{target}"
+            self._unix_path = target
+        self._listener = sock
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fleet-ingest-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            # A bare close does not wake a thread blocked in accept(2) —
+            # the in-flight syscall pins the open file description and the
+            # socket stays LISTENING in the kernel (still accepting
+            # connects!), so the join below would eat its full timeout.
+            # TCP: shutdown() tears the listen state down and wakes the
+            # acceptor.  AF_UNIX: shutdown is a no-op on listeners, so
+            # poke it awake with a throwaway connect (the accept loop
+            # closes post-stop connections immediately).
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            if self._unix_path is not None:
+                try:
+                    poke = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    poke.settimeout(0.5)
+                    poke.connect(self._unix_path)
+                    poke.close()
+                except OSError:
+                    pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            if self._unix_path is not None:
+                try:
+                    import os
+
+                    os.unlink(self._unix_path)
+                except OSError:
+                    pass
+        with self._lock:
+            conns = list(self._conns.values())
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+        for t in list(self._handlers):
+            t.join(timeout=5)
+
+    # ---------------------------------------------------------------- params
+    def publish_params(self, version: int, params: Any) -> None:
+        """Swap in a new versioned param snapshot (numpy trees; callers use
+        ``transport.to_host`` — the device fetch MUST happen caller-side,
+        before donation invalidates the source buffers).  Handlers push it
+        to each actor ahead of that actor's next ack."""
+        with self._lock:
+            self._param_version = int(version)
+            self._params_obj = params
+            self._params_frame = None
+
+    def _params_snapshot(self):
+        """Lazy pack on the FIRST push (a handler thread), once per
+        version; the pickle itself runs OUTSIDE the server lock so other
+        handlers' acks and the drain thread's publishes never stall on
+        it."""
+        with self._lock:
+            version = self._param_version
+            frame, obj = self._params_frame, self._params_obj
+        if frame is None and obj is not None:
+            frame = pack_obj({"version": version, "params": obj})
+            with self._lock:
+                if self._param_version == version and self._params_frame is None:
+                    self._params_frame = frame
+                # else a newer publish raced in: later pushes pack the new
+                # version; THIS push still sends the frame it packed.
+        return version, frame
+
+    def pop_shed_stats(self) -> Dict[str, float]:
+        """Drain the scalar stats banked off shed messages (learner-side,
+        on its log cadence)."""
+        with self._lock:
+            out = dict(self._shed_stats)
+            for k in self._shed_stats:
+                self._shed_stats[k] = 0.0
+        return out
+
+    # ------------------------------------------------------------ connection
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            if self._stop.is_set():
+                # stop()'s wake-up poke (or a raced late dial): drop it.
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            transport.configure_socket(conn)
+            with self._lock:
+                self._conn_seq += 1
+                ident = self._conn_seq
+                self._conns[ident] = conn
+            # Prune finished handlers (only this thread mutates the list):
+            # supervised restarts reconnect indefinitely, and the history
+            # of dead Thread objects must not grow with them.
+            self._handlers = [t for t in self._handlers if t.is_alive()]
+            t = threading.Thread(
+                target=self._handle,
+                args=(ident, conn),
+                name=f"fleet-ingest-conn{ident}",
+                daemon=True,
+            )
+            self._handlers.append(t)
+            t.start()
+
+    def _push_params_if_stale(
+        self, conn: socket.socket, sent_version: int
+    ) -> int:
+        version, frame = self._params_snapshot()
+        if frame is not None and version > sent_version:
+            send_frame(
+                conn, K_PARAMS, frame, max_frame_bytes=self.max_frame_bytes
+            )
+            return version
+        return sent_version
+
+    def _handle(self, ident: int, conn: socket.socket) -> None:
+        actor = "?"
+        try:
+            kind, payload = recv_frame(
+                conn, max_frame_bytes=self.max_frame_bytes
+            )
+            if kind != K_HELLO:
+                raise FrameError(f"expected HELLO, got kind {kind}")
+            hello = unpack_obj(payload)
+            actor = str(hello.get("actor_id", "?"))
+            sent_version = self._push_params_if_stale(conn, 0)
+            send_frame(
+                conn,
+                K_ACK,
+                pack_obj({"code": OK, "param_version": sent_version}),
+            )
+            while not self._stop.is_set():
+                kind, payload = recv_frame(
+                    conn, max_frame_bytes=self.max_frame_bytes
+                )
+                if kind == K_BYE:
+                    return
+                if kind != K_SEQS:
+                    raise FrameError(f"expected SEQS/BYE, got kind {kind}")
+                msg = unpack_obj(payload)
+                msg["actor_id"] = actor
+                n_seqs = int(
+                    np.shape(msg["staged"].seq.reward)[0]
+                )
+                self._obs_frames.labels(actor=actor).inc()
+                self._obs_seqs.labels(actor=actor).inc(n_seqs)
+                self._obs_staleness.labels(actor=actor).set(
+                    self._param_version - int(msg.get("param_version", 0))
+                )
+                try:
+                    self.queue.put(msg, timeout=self.shed_after_s)
+                    code = OK
+                    with self._lock:  # N handler threads share these sums
+                        self.seqs_total += n_seqs
+                except queue.Full:
+                    code = SHED_INGEST
+                    with self._lock:
+                        self.shed_total += 1
+                        for k in self._shed_stats:
+                            self._shed_stats[k] += float(msg.get(k, 0.0))
+                    self._obs_shed.labels(actor=actor).inc()
+                    flight_event(
+                        "shed", code=code, actor=actor,
+                        phase=int(msg.get("phase", -1)),
+                    )
+                sent_version = self._push_params_if_stale(conn, sent_version)
+                send_frame(
+                    conn,
+                    K_ACK,
+                    pack_obj({"code": code, "param_version": sent_version}),
+                )
+        except (FrameError, OSError) as e:
+            if not self._stop.is_set():
+                # A crashed actor's torn stream: note it and drop the
+                # connection — the supervisor owns the restart.
+                flight_event(
+                    "ingest_conn_error",
+                    actor=actor,
+                    error=f"{type(e).__name__}: {e}",
+                )
+        finally:
+            with self._lock:
+                self._conns.pop(ident, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class FleetLearner:
+    """The staging queue's single consumer: drain -> arena add -> K updates.
+
+    Owns the ingest server and the drain/absorb device programs; runs on
+    the calling thread.  ``fleet=off`` (``--actors 0``) never constructs
+    this class — the phase-locked ``Trainer.run`` path is untouched, and
+    tests/test_fleet.py pins that bit-identically.
+    """
+
+    def __init__(self, trainer: Trainer, config: FleetConfig):
+        if trainer.axis is not None:
+            raise ValueError(
+                "FleetLearner needs a host-visible drain boundary; "
+                "shard_map trainers (SPMDTrainer) fuse whole phases — use "
+                "the base Trainer or HostSPMDTrainer"
+            )
+        if config.num_actors < 1:
+            raise ValueError(
+                "FleetLearner requires num_actors >= 1 (fleet=off runs "
+                "Trainer.run directly; there is nothing to ingest)"
+            )
+        if config.queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        self.trainer = trainer
+        self.config = config
+        self.queue: "queue.Queue" = queue.Queue(maxsize=config.queue_depth)
+        self.server = IngestServer(
+            self.queue,
+            address=config.address,
+            shed_after_s=config.shed_after_s,
+            max_frame_bytes=config.max_frame_bytes,
+        )
+        self._drain_prog = jax.jit(
+            lambda ls, st: drain_staged(
+                trainer, ls, st, learn=True, prefetch=config.prefetch
+            ),
+            donate_argnums=(0,),
+        )
+        self._absorb_prog = jax.jit(
+            lambda ls, st: drain_staged(trainer, ls, st, learn=False),
+            donate_argnums=(0,),
+        )
+        reg = get_registry()
+        self._obs_queue_depth = reg.gauge(
+            "r2d2dpg_fleet_staging_queue_depth",
+            "staged batches awaiting drain",
+        )
+        self._obs_queue_depth.set_fn(self.queue.qsize)
+        self.learner_wait = reg.histogram(
+            "r2d2dpg_fleet_learner_wait_seconds",
+            "learner thread blocked on the fleet staging queue (starvation)",
+        )
+        self._stats: Dict[str, float] = {}
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> str:
+        """Bind + start the ingest server; returns the resolved address the
+        supervisor hands to actor subprocesses."""
+        self.server.start()
+        return self.server.address
+
+    def close(self) -> None:
+        """Stop the ingest server.  Callers stop the SUPERVISOR first: an
+        actor that loses its connection while unsupervised exits cleanly,
+        but one mid-send sees a reset — the supervisor must already be in
+        its stopping state so that exit is not treated as a crash."""
+        self.server.stop()
+        self._obs_queue_depth.set(0.0)
+
+    def stats(self) -> Dict[str, float]:
+        """Instrumentation from the most recent ``run`` (throughput +
+        shed/starvation accounting; ``arena_add_seqs_per_sec`` is the
+        bench probe's headline)."""
+        return dict(self._stats)
+
+    # ------------------------------------------------------------------- run
+    def run(
+        self,
+        num_train_phases: int,
+        state: Optional[TrainerState] = None,
+        log_every: int = 50,
+        log_fn=print,
+        metrics_fn: Optional[Callable[[int, Dict[str, float]], None]] = None,
+        minutes: Optional[float] = None,
+    ) -> TrainerState:
+        """Absorb staged batches until ``min_replay`` sequences are
+        resident, then run ``num_train_phases`` drain-learn phases (one
+        staged batch + K updates each — the phase-locked data-to-update
+        ratio, fed from the fleet).  The server must already be started;
+        the caller owns actor lifecycle (supervisor)."""
+        if self.server.address is None:
+            raise RuntimeError("call start() before run()")
+        t = self.trainer
+        state = t.init() if state is None else state
+        cstate, lstate = split_state(state)
+        deadline = (
+            time.monotonic() + minutes * 60 if minutes is not None else None
+        )
+        self.learner_wait.reset()
+        version = 1
+        self.server.publish_params(version, self._snapshot_params(lstate))
+
+        min_seqs = t.config.min_replay
+        absorbed = 0
+        drained = 0
+        last_metrics: Dict[str, Any] = {}
+        # Host-side episode accounting: actors drain their device
+        # accumulators each phase and ship DELTAS as plain floats, so the
+        # sums here stay monotone across supervised actor restarts.
+        ep_ret_sum = 0.0
+        ep_count = 0.0
+        env_steps_total = 0.0
+        last_batch_t = time.monotonic()
+        t0 = time.monotonic()
+        # Steady-state window for throughput claims: everything before the
+        # first drain-learn completes (actor subprocess spawn, jax imports,
+        # program compiles, replay fill) is startup, not sustained rate.
+        train_t0: Optional[float] = None
+        seqs_at_train_t0 = 0
+
+        def emit_log(phase: int, scalars: Dict[str, float]) -> None:
+            if metrics_fn is not None:
+                metrics_fn(phase, scalars)
+                return
+            log_fn(
+                f"fleet phase {phase}/{num_train_phases} "
+                + " ".join(f"{k} {v:.3g}" for k, v in scalars.items())
+            )
+
+        try:
+            while drained < num_train_phases:
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                t_wait = time.monotonic()
+                try:
+                    msg = self.queue.get(timeout=0.5)
+                except queue.Empty:
+                    self.learner_wait.add(time.monotonic() - t_wait)
+                    # Cold-start grace: the FIRST batch pays actor
+                    # subprocess spawn + jax import + collect compile +
+                    # window fill — give it double the steady-state bound.
+                    bound = self.config.idle_timeout_s * (
+                        2.0 if absorbed == 0 else 1.0
+                    )
+                    if time.monotonic() - last_batch_t > bound:
+                        raise RuntimeError(
+                            f"fleet starved: no staged batch in "
+                            f"{self.config.idle_timeout_s:.0f}s — are the "
+                            f"actors alive? (supervisor restarts crashed "
+                            f"ones; check flight.jsonl)"
+                        )
+                    continue
+                self.learner_wait.add(time.monotonic() - t_wait)
+                last_batch_t = time.monotonic()
+                # Fold shed-banked accounting EVERY iteration (a cheap
+                # locked dict swap): only the experience of a shed message
+                # was droppable, and the sums must be right whenever read
+                # (log cadence, log_every=0 probes, end-of-run stats).
+                shed_stats = self.server.pop_shed_stats()
+                env_steps_total += shed_stats["env_steps_delta"]
+                ep_ret_sum += shed_stats["ep_return_sum"]
+                ep_count += shed_stats["ep_count"]
+                staged: StagedSequences = msg["staged"]
+                n_seqs = int(np.shape(staged.seq.reward)[0])
+                ep_ret_sum += float(msg.get("ep_return_sum", 0.0))
+                ep_count += float(msg.get("ep_count", 0.0))
+                env_steps_total += float(msg.get("env_steps_delta", 0.0))
+                absorbed += n_seqs
+                # staged_writer around the COMPILED call: inside the jit
+                # the arena's own guard only runs at trace time, so the
+                # single-writer claim must wrap the execution (replay/
+                # arena.py "SINGLE-WRITER contract").
+                if absorbed <= min_seqs:
+                    with t.arena.staged_writer():
+                        lstate, _ = self._absorb_prog(lstate, staged)
+                    continue
+                with t.arena.staged_writer():
+                    lstate, last_metrics = self._drain_prog(lstate, staged)
+                drained += 1
+                if train_t0 is None:
+                    # The first drain carries the compile; the sustained
+                    # window starts once it has actually executed.
+                    jax.block_until_ready(lstate.train.step)
+                    train_t0 = time.monotonic()
+                    seqs_at_train_t0 = absorbed
+                if drained % max(self.config.publish_every, 1) == 0:
+                    version += 1
+                    self.server.publish_params(
+                        version, self._snapshot_params(lstate)
+                    )
+                    # Flight-ring discipline (training/pipeline.py
+                    # _publish): record on the log cadence only, so
+                    # publishes don't evict the rare events.
+                    if log_every and drained % log_every == 0:
+                        flight_event("param_publish", version=version)
+                if log_every and drained % log_every == 0:
+                    lstep, m = jax.device_get(
+                        (lstate.train.step, last_metrics)
+                    )
+                    scalars = {
+                        "episode_return_mean": ep_ret_sum / max(ep_count, 1.0),
+                        "episodes": ep_count,
+                        "env_steps": env_steps_total,
+                        "learner_steps": float(lstep),
+                        **{k: float(v) for k, v in m.items()},
+                    }
+                    ep_ret_sum = 0.0
+                    ep_count = 0.0
+                    t._obs_publish(scalars)
+                    emit_log(drained, scalars)
+        finally:
+            jax.block_until_ready(lstate.train.step)
+            wall = max(time.monotonic() - t0, 1e-9)
+            _, lw_total, lw_p50, lw_p99 = self.learner_wait.snapshot()
+            self._stats = {
+                "train_phases": float(drained),
+                "absorbed_seqs": float(absorbed),
+                "wall_s": wall,
+                "learner_steps_per_sec": (
+                    drained * t.config.learner_steps / wall
+                ),
+                "arena_add_seqs_per_sec": absorbed / wall,
+                "sheds": float(self.server.shed_total),
+                "learner_wait_p50_ms": lw_p50 * 1e3,
+                "learner_wait_p99_ms": lw_p99 * 1e3,
+                "learner_wait_total_s": lw_total,
+            }
+            if train_t0 is not None:
+                # Steady-state window rates (the bench probe's keys): the
+                # plain *_per_sec above span the WHOLE run, startup
+                # included — honest for operations, wrong for throughput
+                # comparisons.
+                train_wall = max(time.monotonic() - train_t0, 1e-9)
+                self._stats["train_wall_s"] = train_wall
+                self._stats["train_arena_add_seqs_per_sec"] = (
+                    absorbed - seqs_at_train_t0
+                ) / train_wall
+                self._stats["train_learner_steps_per_sec"] = (
+                    max(drained - 1, 0) * t.config.learner_steps / train_wall
+                )
+        # phase_idx is a collector-slice field the fleet learner never
+        # advances; stamp the drained-phase count so the final checkpoint
+        # step (and any tooling keyed on it) reflects the trained run.
+        return dataclasses.replace(
+            merge_state(state, cstate, lstate),
+            phase_idx=cstate.phase_idx + drained,
+        )
+
+    def _snapshot_params(self, lstate: LearnerState) -> Any:
+        """The published snapshot: everything an actor needs to act AND to
+        rank fresh sequences locally (``agent.initial_priority`` burns in
+        online + target nets of both cores — Ape-X actors rank with their
+        stale copies of all four)."""
+        train = lstate.train
+        return to_host(
+            {
+                "actor_params": train.actor_params,
+                "critic_params": train.critic_params,
+                "target_actor_params": train.target_actor_params,
+                "target_critic_params": train.target_critic_params,
+                "step": train.step,
+            }
+        )

@@ -1,0 +1,262 @@
+"""Actor supervision: spawn, watch, restart with exponential backoff.
+
+The reference repo's ``main.py`` spawns actor processes and forgets them;
+a crashed actor silently thins the fleet forever.  Here the supervisor is
+the fleet's process-lifecycle owner: it spawns each actor as a
+subprocess, polls liveness on a monitor thread, and restarts any actor
+that exits while the fleet is live — after an exponential backoff (a
+crash-looping actor must not fork-bomb the host), reset once an
+incarnation survives ``healthy_after_s`` (a crash after an hour is bad
+luck, not a loop).  Every crash lands in the flight recorder
+(``actor_crash`` with actor id, returncode, restart count), so a fleet
+post-mortem's first question — "who died, when, how often" — reads
+straight out of ``flight.jsonl``.
+
+Actors are forced onto CPU (``JAX_PLATFORMS=cpu`` + the axon plugin gate
+cleared): env stepping is host work, and an actor subprocess grabbing the
+learner's accelerator would wedge both.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from r2d2dpg_tpu.obs import flight_event
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorConfig:
+    backoff_base_s: float = 0.5  # first restart delay; doubles per crash
+    backoff_max_s: float = 30.0
+    healthy_after_s: float = 60.0  # uptime that resets the backoff ladder
+    max_restarts: Optional[int] = None  # per actor; None = never give up
+    poll_s: float = 0.2
+
+
+@dataclasses.dataclass
+class _ActorSlot:
+    proc: Optional[subprocess.Popen] = None
+    started_at: float = 0.0
+    restarts: int = 0
+    consecutive_crashes: int = 0
+    restart_at: Optional[float] = None  # backoff deadline when dead
+    gave_up: bool = False
+
+
+class ActorSupervisor:
+    """Owns ``num_actors`` actor subprocesses for the life of a fleet run.
+
+    ``argv_fn(actor_id)`` builds each actor's command line (train.py wires
+    ``python -m r2d2dpg_tpu.fleet.actor ...`` with the ingest address);
+    ``log_path_fn(actor_id)``, when given, routes the actor's
+    stdout/stderr to a per-actor file for post-mortems.
+    """
+
+    def __init__(
+        self,
+        argv_fn: Callable[[int], List[str]],
+        num_actors: int,
+        *,
+        config: SupervisorConfig = SupervisorConfig(),
+        env: Optional[Dict[str, str]] = None,
+        log_path_fn: Optional[Callable[[int], str]] = None,
+    ):
+        if num_actors < 1:
+            raise ValueError("num_actors must be >= 1")
+        self.argv_fn = argv_fn
+        self.num_actors = num_actors
+        self.config = config
+        self.log_path_fn = log_path_fn
+        self._env = dict(os.environ if env is None else env)
+        # CPU discipline (module docstring): clear the axon sitecustomize
+        # gate so the plugin never registers in the child, and pin cpu.
+        self._env.pop("PALLAS_AXON_POOL_IPS", None)
+        self._env["JAX_PLATFORMS"] = "cpu"
+        self._env.setdefault("R2D2DPG_PALLAS_INTERPRET", "1")
+        self._slots: Dict[int, _ActorSlot] = {
+            i: _ActorSlot() for i in range(num_actors)
+        }
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "ActorSupervisor":
+        if self._monitor is not None:
+            raise RuntimeError("supervisor already started")
+        for i in range(self.num_actors):
+            self._spawn(i)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="fleet-supervisor", daemon=True
+        )
+        self._monitor.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Orderly teardown: no restarts from here on, SIGTERM the fleet,
+        SIGKILL stragglers.  Call BEFORE stopping the ingest server so a
+        connection reset never masquerades as a crash."""
+        self._stopping.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+        with self._lock:
+            procs = [s.proc for s in self._slots.values() if s.proc is not None]
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + timeout
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(deadline - time.monotonic(), 0.1))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
+    # ------------------------------------------------------------ inspection
+    def alive_count(self) -> int:
+        with self._lock:
+            return sum(
+                1
+                for s in self._slots.values()
+                if s.proc is not None and s.proc.poll() is None
+            )
+
+    @property
+    def restarts_total(self) -> int:
+        with self._lock:
+            return sum(s.restarts for s in self._slots.values())
+
+    def kill_actor(self, actor_id: int) -> None:
+        """Test/drill hook: hard-kill one actor (the supervisor sees a
+        crash and walks the restart path — the soak test's lever)."""
+        with self._lock:
+            proc = self._slots[actor_id].proc
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+    # -------------------------------------------------------------- internal
+    def _spawn(self, actor_id: int) -> None:
+        slot = self._slots[actor_id]
+        stdout = subprocess.DEVNULL
+        if self.log_path_fn is not None:
+            stdout = open(self.log_path_fn(actor_id), "ab")
+        try:
+            slot.proc = subprocess.Popen(
+                self.argv_fn(actor_id),
+                env=self._env,
+                stdout=stdout,
+                stderr=subprocess.STDOUT,
+                stdin=subprocess.DEVNULL,
+            )
+        finally:
+            if stdout is not subprocess.DEVNULL:
+                stdout.close()  # child holds its own fd
+        slot.started_at = time.monotonic()
+        slot.restart_at = None
+
+    def _monitor_loop(self) -> None:
+        cfg = self.config
+        while not self._stopping.is_set():
+            now = time.monotonic()
+            with self._lock:
+                for actor_id, slot in self._slots.items():
+                    if slot.gave_up:
+                        continue
+                    if slot.proc is not None and slot.proc.poll() is None:
+                        # Healthy uptime resets the backoff ladder.
+                        if (
+                            slot.consecutive_crashes
+                            and now - slot.started_at > cfg.healthy_after_s
+                        ):
+                            slot.consecutive_crashes = 0
+                        continue
+                    if slot.proc is not None and slot.restart_at is None:
+                        # Fresh corpse: record, arm the backoff.
+                        rc = slot.proc.returncode
+                        slot.consecutive_crashes += 1
+                        backoff = min(
+                            cfg.backoff_base_s
+                            * (2 ** (slot.consecutive_crashes - 1)),
+                            cfg.backoff_max_s,
+                        )
+                        flight_event(
+                            "actor_crash",
+                            actor=actor_id,
+                            returncode=rc,
+                            restarts=slot.restarts,
+                            backoff_s=round(backoff, 3),
+                        )
+                        if (
+                            cfg.max_restarts is not None
+                            and slot.restarts >= cfg.max_restarts
+                        ):
+                            slot.gave_up = True
+                            flight_event(
+                                "actor_gave_up",
+                                actor=actor_id,
+                                restarts=slot.restarts,
+                            )
+                            continue
+                        slot.restart_at = now + backoff
+                    if (
+                        slot.restart_at is not None
+                        and now >= slot.restart_at
+                    ):
+                        # A failed spawn (logdir vanished, ENOSPC, exec
+                        # error) must not kill THIS thread — supervision
+                        # is the subsystem's headline feature.  Note it
+                        # and retry on the max backoff.
+                        try:
+                            self._spawn(actor_id)
+                        except Exception as e:  # noqa: BLE001
+                            flight_event(
+                                "actor_spawn_failed",
+                                actor=actor_id,
+                                error=f"{type(e).__name__}: {e}",
+                            )
+                            slot.restart_at = now + cfg.backoff_max_s
+                            continue
+                        slot.restarts += 1
+                        flight_event(
+                            "actor_restart",
+                            actor=actor_id,
+                            restarts=slot.restarts,
+                        )
+            self._stopping.wait(cfg.poll_s)
+
+
+def default_actor_argv(
+    actor_id: int,
+    *,
+    config_name: str,
+    address: str,
+    num_actors: int,
+    seed: Optional[int] = None,
+    extra: Optional[List[str]] = None,
+) -> List[str]:
+    """The standard actor command line (train.py's spawner)."""
+    argv = [
+        sys.executable,
+        "-m",
+        "r2d2dpg_tpu.fleet.actor",
+        "--config",
+        config_name,
+        "--connect",
+        address,
+        "--actor-id",
+        str(actor_id),
+        "--num-actors",
+        str(num_actors),
+    ]
+    if seed is not None:
+        argv += ["--seed", str(seed)]
+    if extra:
+        argv += list(extra)
+    return argv

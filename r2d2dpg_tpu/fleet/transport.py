@@ -1,0 +1,212 @@
+"""Fleet wire protocol: length-prefixed, CRC-checked frames over sockets.
+
+Actors and the learner's ingest server are separate OS processes (Ape-X /
+R2D2 topology, PAPERS.md 1803.00933), so experience and params cross a
+byte stream — localhost TCP (``"host:port"``) or a Unix domain socket
+(``"unix:/path"``).  Every message is one frame::
+
+    +--------+------+-----------+--------+----------------+
+    | magic  | kind | length u64| crc32  | payload bytes  |
+    | 4B R2F1|  1B  |    8B     |   4B   |  <= max_frame  |
+    +--------+------+-----------+--------+----------------+
+
+- **Length prefix** bounds the read; a declared length past
+  ``max_frame_bytes`` is refused BEFORE any allocation (``FrameTooLarge``),
+  so a corrupt header cannot OOM the learner.
+- **CRC32** (zlib) over the payload catches truncation/bit-rot that TCP's
+  checksum missed or a torn Unix-socket write produced (``FrameCRCError``).
+- **EOF mid-frame** raises ``FrameTruncated`` — a half-written frame from a
+  crashed actor never silently becomes a short payload.
+
+Payloads are pickled Python objects (protocol 4): the pytrees crossing the
+wire (``replay.StagedSequences`` with numpy leaves, param snapshots) are
+registered dataclasses that round-trip natively.  Integrity, not
+authentication — both ends are subprocesses of one trusted training run on
+one host (the supervisor spawns the actors); never point an ingest server
+at an untrusted network.
+
+Backpressure is explicit, not buffered: ``send_frame`` uses a blocking
+``sendall`` on a socket whose send buffer is clamped small
+(``configure_socket``), and the fleet protocol acknowledges every
+experience frame (``fleet/ingest.py``) — an actor has at most ONE
+unacknowledged batch in flight, so a stalled learner stalls actors at the
+next send instead of ballooning kernel buffers with stale experience.
+Shed codes ride the acks (``utils/codes.py``).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import zlib
+from typing import Any, Tuple
+
+import numpy as np
+
+MAGIC = b"R2F1"
+_HEADER = struct.Struct("!4sBQI")  # magic, kind, payload length, crc32
+HEADER_BYTES = _HEADER.size
+
+# Frame kinds (one byte on the wire).
+K_HELLO = 1  # actor -> ingest: {"actor_id", ...} once per connection
+K_SEQS = 2  # actor -> ingest: one staged experience batch + actor stats
+K_ACK = 3  # ingest -> actor: {"code": OK|SHED_INGEST, "param_version": v}
+K_PARAMS = 4  # ingest -> actor: {"version": v, "params": {...numpy trees}}
+K_BYE = 5  # either side: orderly goodbye
+
+# 256 MiB default ceiling: a humanoid-shaped staged batch (256 envs x seq
+# 85) is ~20 MiB, so this bounds corruption blast radius without touching
+# any real config.
+MAX_FRAME_BYTES = 256 * 1024 * 1024
+
+# Clamp for SO_SNDBUF/SO_RCVBUF: big enough to stream a batch without
+# per-chunk stalls, small enough that a wedged peer surfaces as a blocked
+# send in seconds (the backpressure signal), not minutes of kernel-buffered
+# stale experience.
+SOCKET_BUF_BYTES = 1 * 1024 * 1024
+
+
+class FrameError(Exception):
+    """Base class for wire-protocol violations."""
+
+
+class FrameTruncated(FrameError):
+    """Peer closed (or stream ended) mid-frame."""
+
+
+class FrameCRCError(FrameError):
+    """Payload bytes do not match the header's CRC32."""
+
+
+class FrameTooLarge(FrameError):
+    """Declared payload length exceeds the frame ceiling."""
+
+
+class FrameBadMagic(FrameError):
+    """Stream is not positioned at a frame boundary (or not our protocol)."""
+
+
+# ------------------------------------------------------------------ framing
+def encode_frame(
+    kind: int, payload: bytes, *, max_frame_bytes: int = MAX_FRAME_BYTES
+) -> bytes:
+    """Header + payload as one bytes object (small frames; big ones go
+    through ``send_frame`` which avoids the extra copy)."""
+    if len(payload) > max_frame_bytes:
+        raise FrameTooLarge(
+            f"payload {len(payload)}B exceeds frame ceiling {max_frame_bytes}B"
+        )
+    return (
+        _HEADER.pack(MAGIC, kind, len(payload), zlib.crc32(payload)) + payload
+    )
+
+
+def send_frame(
+    sock: socket.socket,
+    kind: int,
+    payload: bytes,
+    *,
+    max_frame_bytes: int = MAX_FRAME_BYTES,
+) -> None:
+    """Blocking framed send; the blocking IS the backpressure (module doc)."""
+    if len(payload) > max_frame_bytes:
+        raise FrameTooLarge(
+            f"payload {len(payload)}B exceeds frame ceiling {max_frame_bytes}B"
+        )
+    sock.sendall(_HEADER.pack(MAGIC, kind, len(payload), zlib.crc32(payload)))
+    sock.sendall(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise FrameTruncated(f"EOF after {got}/{n} bytes")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(
+    sock: socket.socket, *, max_frame_bytes: int = MAX_FRAME_BYTES
+) -> Tuple[int, bytes]:
+    """Read one frame -> (kind, payload).  Raises FrameError subclasses on
+    any protocol violation (the caller decides whether that kills the
+    connection — it should)."""
+    header = _recv_exact(sock, HEADER_BYTES)
+    magic, kind, length, crc = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise FrameBadMagic(f"bad magic {magic!r}")
+    if length > max_frame_bytes:
+        raise FrameTooLarge(
+            f"declared payload {length}B exceeds frame ceiling "
+            f"{max_frame_bytes}B"
+        )
+    payload = _recv_exact(sock, length)
+    if zlib.crc32(payload) != crc:
+        raise FrameCRCError(
+            f"crc mismatch on {length}B payload (kind {kind})"
+        )
+    return kind, payload
+
+
+# ----------------------------------------------------------------- payloads
+def pack_obj(obj: Any) -> bytes:
+    """Serialize one message payload (numpy-leaved pytrees, dicts)."""
+    return pickle.dumps(obj, protocol=4)
+
+
+def unpack_obj(payload: bytes) -> Any:
+    return pickle.loads(payload)
+
+
+def to_host(tree: Any) -> Any:
+    """Device pytree -> numpy pytree, ready for ``pack_obj``.
+
+    One batched transfer (``jax.device_get`` on the whole tree), not one
+    per leaf; numpy leaves pass through untouched."""
+    import jax
+
+    return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
+
+
+# ------------------------------------------------------------------- address
+def parse_address(addr: str):
+    """``"host:port"`` -> (AF_INET, (host, port)); ``"unix:/path"`` ->
+    (AF_UNIX, path)."""
+    if addr.startswith("unix:"):
+        return socket.AF_UNIX, addr[len("unix:"):]
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(
+            f"address {addr!r} is neither 'host:port' nor 'unix:/path'"
+        )
+    return socket.AF_INET, (host, int(port))
+
+
+def configure_socket(sock: socket.socket) -> socket.socket:
+    """Apply the fleet's socket discipline: clamped buffers (bounded
+    kernel-side staleness — module doc) and no Nagle delay on TCP (acks are
+    tiny; a 40 ms coalescing stall per phase would dwarf them)."""
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, SOCKET_BUF_BYTES)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, SOCKET_BUF_BYTES)
+    if sock.family == socket.AF_INET:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def connect(addr: str, *, timeout: float = 30.0) -> socket.socket:
+    """Dial an ingest server; returns a configured, connected socket."""
+    family, target = parse_address(addr)
+    sock = socket.socket(family, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    try:
+        sock.connect(target)
+    except OSError:
+        sock.close()
+        raise
+    sock.settimeout(None)
+    return configure_socket(sock)

@@ -26,6 +26,7 @@ from r2d2dpg_tpu.obs.flight import (
     FlightRecorder,
     flight_event,
     get_flight_recorder,
+    set_flight_identity,
 )
 from r2d2dpg_tpu.obs.registry import (
     Counter,
@@ -54,6 +55,7 @@ __all__ = [
     "flight_event",
     "get_flight_recorder",
     "get_registry",
+    "set_flight_identity",
     "start_exporter",
     "stop_exporter",
 ]

@@ -12,7 +12,15 @@ Dumps are JSONL (one event per line, oldest first) written atomically
 carries::
 
     {"kind": ..., "t_wall": <unix seconds>, "t_mono": <monotonic seconds>,
-     "seq": <monotone index>, "thread": <recording thread name>, ...fields}
+     "seq": <monotone index>, "thread": <recording thread name>,
+     "pid": <os pid>, ...identity, ...fields}
+
+Identity stamping (fleet/multi-host post-mortems): every process in a
+fleet writes its own ``flight.jsonl``, and interleaving them by ``t_wall``
+is only useful if each line says WHO recorded it.  ``set_flight_identity``
+stamps process-wide fields (``process_index`` for
+``parallel.distributed.initialize()`` hosts, ``actor`` for fleet actor
+subprocesses) onto every subsequent event; ``pid`` is always stamped.
 
 Hard crashes (SIGSEGV & friends) cannot run Python: ``install()`` also
 points ``faulthandler`` at a sidecar ``<path>.fault`` file so native
@@ -43,6 +51,15 @@ class FlightRecorder:
         self._seq = 0
         self._installed_path: Optional[str] = None
         self._fault_file = None
+        self._identity: Dict[str, object] = {}
+
+    # -------------------------------------------------------------- identity
+    def set_identity(self, **fields) -> None:
+        """Stamp who-is-recording fields (``process_index``, ``actor``, ...)
+        onto every subsequent event.  Merges: later calls add/overwrite keys
+        without dropping earlier ones."""
+        with self._lock:
+            self._identity.update(fields)
 
     # ---------------------------------------------------------------- record
     def record(self, kind: str, **fields) -> None:
@@ -51,9 +68,11 @@ class FlightRecorder:
             "t_wall": time.time(),
             "t_mono": time.monotonic(),
             "thread": threading.current_thread().name,
+            "pid": os.getpid(),
         }
-        event.update(fields)
         with self._lock:
+            event.update(self._identity)
+            event.update(fields)  # explicit fields win over identity
             event["seq"] = self._seq
             self._seq += 1
             self._ring.append(event)
@@ -132,3 +151,11 @@ def get_flight_recorder() -> FlightRecorder:
 def flight_event(kind: str, **fields) -> None:
     """Record one event into the process recorder (the library-side API)."""
     _RECORDER.record(kind, **fields)
+
+
+def set_flight_identity(**fields) -> None:
+    """Stamp identity fields (``process_index``, ``actor``, ...) onto every
+    subsequent event of the process recorder, so fleet post-mortems can
+    interleave multiple processes' ``flight.jsonl`` dumps by wall time and
+    still attribute each line."""
+    _RECORDER.set_identity(**fields)

@@ -27,6 +27,7 @@ recurrent carries of actor and critic nets captured at window start.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Dict, Tuple
 
 import jax
@@ -91,6 +92,25 @@ class StagedSequences:
     priorities: Any  # [B] float32, or None (learner-computed at drain)
 
 
+class _StagedWriterClaim:
+    """``with arena.staged_writer():`` — loud refusal on overlap."""
+
+    def __init__(self, lock):
+        self._lock = lock
+
+    def __enter__(self):
+        if not self._lock.acquire(blocking=False):
+            raise RuntimeError(
+                "ReplayArena.add_staged is single-writer: another thread is "
+                "mid-add on this arena.  Route producers through a staging "
+                "queue drained by one thread (docs/FLEET.md)"
+            )
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+
+
 class ReplayArena:
     """Static replay configuration + pure state-transition functions.
 
@@ -137,6 +157,10 @@ class ReplayArena:
             "r2d2dpg_replay_sequences_added",
             "monotone count of sequences ever added",
         )
+        # Single-writer guard for the staged path (see staged_writer /
+        # add_staged).  Reentrant: the drain loops hold it around their
+        # jitted call while add_staged re-acquires inside the trace.
+        self._staged_writer_lock = threading.RLock()
 
     def observe_state_scalars(
         self, occupancy: float, priority_sum: float, total_added: float
@@ -190,13 +214,35 @@ class ReplayArena:
 
         ``staged.priorities`` must be resolved by the caller (the drain
         program fills ``None`` via ``Trainer._initial_priorities`` before
-        calling) — the arena itself has no nets to rank with."""
+        calling) — the arena itself has no nets to rank with.
+
+        SINGLE-WRITER contract: ``add`` is a pure state transition, so two
+        threads calling it concurrently on the same ``ArenaState`` (e.g. a
+        fleet ingest handler racing a local collector) would each produce a
+        new state from the SAME input and one side's sequences would be
+        silently lost when the caller threads the wrong result forward.
+        Producers must route through a staging queue drained by ONE thread
+        (training/pipeline.py, fleet/ingest.py; docs/FLEET.md "Single
+        writer").  The ``staged_writer`` guard turns a violated contract
+        into a loud error instead of silent data loss — but note it only
+        fires HERE for eager callers: inside a jitted drain program this
+        body runs at trace time, so drain loops must hold ``staged_writer``
+        around the compiled call itself (fleet/ingest.py does)."""
         if staged.priorities is None:
             raise ValueError(
                 "add_staged needs resolved priorities; compute them "
                 "(e.g. Trainer._initial_priorities) before absorbing"
             )
-        return self.add(state, staged.seq, staged.priorities)
+        with self.staged_writer():
+            return self.add(state, staged.seq, staged.priorities)
+
+    def staged_writer(self):
+        """Non-blocking claim of the single staged-writer slot (a context
+        manager).  Overlapping claims from another thread are exactly the
+        lost-update race, so they raise loudly; the lock is reentrant so a
+        drain loop can hold it around its jitted call while ``add_staged``
+        re-claims inside the trace."""
+        return _StagedWriterClaim(self._staged_writer_lock)
 
     # ------------------------------------------------------------------ size
     def size(self, state: ArenaState) -> jnp.ndarray:

@@ -32,12 +32,10 @@ from typing import Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
-# Response codes (string enum kept dumb on purpose: they cross process
-# boundaries via the JSONL CLI and land in logs).
-OK = "ok"
-SHED_QUEUE = "shed_queue_full"
-SHED_SESSIONS = "shed_session_capacity"
-SHUTDOWN = "shutdown"
+# Response codes live in utils/codes.py (shared with fleet ingest so the
+# two admission layers cannot drift apart); re-exported here because they
+# are part of this module's public surface.
+from r2d2dpg_tpu.utils.codes import OK, SHED_QUEUE, SHED_SESSIONS, SHUTDOWN
 
 
 @dataclasses.dataclass

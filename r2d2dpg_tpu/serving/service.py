@@ -36,14 +36,12 @@ import numpy as np
 
 from r2d2dpg_tpu.models.actor_critic import ActorNet, policy_step_fn
 from r2d2dpg_tpu.obs import flight_event
-from r2d2dpg_tpu.serving.batcher import (
+from r2d2dpg_tpu.serving.batcher import MicroBatcher, Request, bucket_for
+from r2d2dpg_tpu.utils.codes import (
     OK,
     SHED_QUEUE,
     SHED_SESSIONS,
     SHUTDOWN,
-    MicroBatcher,
-    Request,
-    bucket_for,
 )
 from r2d2dpg_tpu.serving.health import HealthSnapshot
 from r2d2dpg_tpu.serving.reload import CheckpointHotReloader

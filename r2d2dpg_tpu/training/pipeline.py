@@ -120,6 +120,45 @@ class LearnerState:
 _COLLECT_FIELDS = tuple(f.name for f in dataclasses.fields(CollectorState))
 
 
+def drain_staged(
+    trainer: Trainer,
+    lstate: LearnerState,
+    staged: StagedSequences,
+    *,
+    learn: bool = True,
+    prefetch: bool = True,
+) -> Tuple[LearnerState, Dict[str, jnp.ndarray]]:
+    """The learner-side drain body: resolve priorities -> arena add -> K
+    updates (double-buffered sampling when ``prefetch``).
+
+    Shared by the in-process pipelined executor (``_drain_learn_impl``) and
+    the fleet learner (fleet/ingest.py) so the two staging-queue consumers
+    cannot drift: an out-of-process actor's batch enters the arena through
+    the exact code path a local collector's does.  ``staged.priorities`` may
+    be pre-resolved (fleet actors rank locally with their stale nets, the
+    Ape-X contract) or ``None`` (ranked here with the learner's current
+    nets).  ``learn=False`` absorbs without updating — the fleet's
+    replay-fill mode before ``min_replay`` sequences are resident."""
+    t = trainer
+    rng, key = jax.random.split(lstate.rng)
+    key = t._fold_axis(key)
+    with scope("pipeline_add"):
+        prios = staged.priorities
+        if prios is None:
+            prios = t._initial_priorities(lstate.train, lstate.arena, staged.seq)
+        seq, prios = t._reshard_add(staged.seq, prios)
+        arena = t.arena.add_staged(
+            lstate.arena, StagedSequences(seq=seq, priorities=prios)
+        )
+    if not learn:
+        return LearnerState(train=lstate.train, arena=arena, rng=rng), {}
+    with scope("pipeline_learn"):
+        train, arena, metrics = t._learn_many(
+            lstate.train, arena, key, prefetch=prefetch
+        )
+    return LearnerState(train=train, arena=arena, rng=rng), metrics
+
+
 def split_state(state: TrainerState) -> Tuple[CollectorState, LearnerState]:
     """Partition a ``TrainerState`` into the two threads' disjoint slices.
 
@@ -256,26 +295,11 @@ class PipelineExecutor:
     def _drain_learn_impl(
         self, lstate: LearnerState, staged: StagedSequences
     ) -> Tuple[LearnerState, Dict[str, jnp.ndarray]]:
-        """The learner's program: resolve priorities -> arena add -> K
-        updates (double-buffered sampling when ``prefetch``)."""
-        t = self.trainer
-        rng, key = jax.random.split(lstate.rng)
-        key = t._fold_axis(key)
-        with scope("pipeline_add"):
-            prios = staged.priorities
-            if prios is None:
-                prios = t._initial_priorities(
-                    lstate.train, lstate.arena, staged.seq
-                )
-            seq, prios = t._reshard_add(staged.seq, prios)
-            arena = t.arena.add_staged(
-                lstate.arena, StagedSequences(seq=seq, priorities=prios)
-            )
-        with scope("pipeline_learn"):
-            train, arena, metrics = t._learn_many(
-                lstate.train, arena, key, prefetch=self.config.prefetch
-            )
-        return LearnerState(train=train, arena=arena, rng=rng), metrics
+        """The learner's program: the shared ``drain_staged`` body at this
+        executor's prefetch setting."""
+        return drain_staged(
+            self.trainer, lstate, staged, prefetch=self.config.prefetch
+        )
 
     # ------------------------------------------------------- host-side parts
     def _collect_phase_pipelined(

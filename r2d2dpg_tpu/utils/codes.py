@@ -1,0 +1,25 @@
+"""Shared response/shed codes: one vocabulary for every admission layer.
+
+Serving's micro-batcher (serving/batcher.py) and the fleet ingest server
+(fleet/ingest.py) both degrade under load by *refusing with a code* rather
+than queueing unboundedly or raising — overload is an expected state, not
+an error.  The codes live here so the two subsystems cannot drift apart
+(an operator's shed-rate alert matches one string set) and stay dumb
+strings on purpose: they cross process boundaries via the serving JSONL
+CLI and the fleet wire protocol and land verbatim in logs and
+``flight.jsonl`` events.
+"""
+
+from __future__ import annotations
+
+OK = "ok"
+# Serving admission: the micro-batcher's bounded request queue is full.
+SHED_QUEUE = "shed_queue_full"
+# Serving admission: the session-slot table is full after a TTL sweep.
+SHED_SESSIONS = "shed_session_capacity"
+# Fleet ingest: the learner's staging queue is full — the actor sheds the
+# batch (collection outran learning past the queue bound) and keeps going.
+SHED_INGEST = "shed_ingest_queue_full"
+SHUTDOWN = "shutdown"
+
+ALL_SHED_CODES = (SHED_QUEUE, SHED_SESSIONS, SHED_INGEST)

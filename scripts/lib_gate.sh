@@ -98,12 +98,15 @@ run_evidence() {
         touch "$dir/.train_complete"
       fi
     fi
-    # Pipelined runs (--pipeline 1): the executor owns the phase loop and
-    # rejects periodic eval (train.py guard), so mid-run eval curves are
-    # dropped for them — the blessing evidence is the FINAL 20-ep eval
-    # below either way, which still runs off the final checkpoint.
+    # Pipelined (--pipeline 1) and fleet (--actors N) runs: those
+    # executors own the phase loop and REFUSE periodic eval (train.py
+    # guards), so mid-run eval curves are dropped for them — the blessing
+    # evidence is the FINAL 20-ep eval below either way, which still runs
+    # off the final checkpoint.
     local evalevery=150
-    case " $* " in *" --pipeline 1 "*) evalevery=0 ;; esac
+    case " $* " in
+      *" --pipeline 1 "*|*" --actors "[1-9]*) evalevery=0 ;;
+    esac
     if ! [ -f "$dir/.train_complete" ]; then
       echo "=== $dir attempt $attempt train start ($*) $(date) ==="
       rm -rf "$dir"
@@ -127,6 +130,10 @@ run_evidence() {
       wait_on_box "$waitpat"
       if ! pipeline_gate "$dir" "$@"; then
         echo "$dir: pipeline determinism gate FAILED (attempt $attempt)"
+        continue
+      fi
+      if ! fleet_gate "$dir" "$@"; then
+        echo "$dir: fleet determinism gate FAILED (attempt $attempt)"
         continue
       fi
       timeout --kill-after=30 --signal=TERM 1800 \
@@ -165,6 +172,34 @@ pipeline_gate() {
          -k determinism \
        > "$dir/pipeline_gate.log" 2>&1; then
     touch "$dir/.pipeline_determinism_ok"
+    return 0
+  fi
+  return 1
+}
+
+# Fleet evidence gate (ISSUE 4): a run dir trained with --actors N may
+# only be blessed (.done) if the fleet=off determinism test passes on this
+# checkout — proof that wiring the fleet subsystem into train.py left the
+# default schedule bit-faithful to Trainer.run before any fleet number
+# becomes evidence (docs/FLEET.md "Determinism anchor").  Same stamping
+# discipline as pipeline_gate; non-fleet runs pass through untouched.
+#   fleet_gate <dir> <train args...>
+fleet_gate() {
+  local dir=$1
+  shift
+  case " $* " in
+    *" --actors "[1-9]*) ;;
+    *) return 0 ;;  # not a fleet run (or --actors 0): nothing to gate
+  esac
+  if [ -f "$dir/.fleet_determinism_ok" ]; then
+    return 0
+  fi
+  if timeout --kill-after=30 900 \
+       env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+       python -m pytest tests/test_fleet.py -q -p no:cacheprovider \
+         -k determinism \
+       > "$dir/fleet_gate.log" 2>&1; then
+    touch "$dir/.fleet_determinism_ok"
     return 0
   fi
   return 1

@@ -1,0 +1,186 @@
+"""Fleet wire protocol (fleet/transport.py).
+
+The ISSUE 4 satellite coverage: truncated frame, CRC mismatch, oversized
+payload, and the actor-side param-version regression guard (a delayed
+PARAMS frame must never roll the policy backwards).
+"""
+
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.fleet import transport
+from r2d2dpg_tpu.fleet.transport import (
+    HEADER_BYTES,
+    K_SEQS,
+    FrameBadMagic,
+    FrameCRCError,
+    FrameTooLarge,
+    FrameTruncated,
+    encode_frame,
+    pack_obj,
+    parse_address,
+    recv_frame,
+    send_frame,
+    unpack_obj,
+)
+from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
+
+pytestmark = pytest.mark.fleet
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5)
+    b.settimeout(5)
+    return a, b
+
+
+def _staged(b=2, l=3, obs=4, act=2):
+    rng = np.random.default_rng(0)
+    return StagedSequences(
+        seq=SequenceBatch(
+            obs=rng.normal(size=(b, l, obs)).astype(np.float32),
+            action=rng.normal(size=(b, l, act)).astype(np.float32),
+            reward=rng.normal(size=(b, l)).astype(np.float32),
+            discount=np.ones((b, l), np.float32),
+            reset=np.zeros((b, l), np.float32),
+            carries={},
+        ),
+        priorities=np.arange(1.0, b + 1.0, dtype=np.float32),
+    )
+
+
+def test_frame_round_trip_with_pytree_payload():
+    a, b = _pair()
+    staged = _staged()
+    send_frame(a, K_SEQS, pack_obj({"staged": staged, "phase": 7}))
+    kind, payload = recv_frame(b)
+    assert kind == K_SEQS
+    msg = unpack_obj(payload)
+    assert msg["phase"] == 7
+    got = msg["staged"]
+    np.testing.assert_array_equal(got.seq.obs, staged.seq.obs)
+    np.testing.assert_array_equal(got.priorities, staged.priorities)
+    a.close(), b.close()
+
+
+def test_truncated_frame_raises():
+    a, b = _pair()
+    frame = encode_frame(K_SEQS, b"x" * 64)
+    a.sendall(frame[: HEADER_BYTES + 10])  # header + partial payload
+    a.close()
+    with pytest.raises(FrameTruncated):
+        recv_frame(b)
+    b.close()
+
+
+def test_truncated_header_raises():
+    a, b = _pair()
+    a.sendall(encode_frame(K_SEQS, b"")[: HEADER_BYTES - 3])
+    a.close()
+    with pytest.raises(FrameTruncated):
+        recv_frame(b)
+    b.close()
+
+
+def test_crc_mismatch_raises():
+    a, b = _pair()
+    frame = bytearray(encode_frame(K_SEQS, b"hello world"))
+    frame[-1] ^= 0xFF  # flip a payload bit AFTER the crc was computed
+    a.sendall(bytes(frame))
+    with pytest.raises(FrameCRCError):
+        recv_frame(b)
+    a.close(), b.close()
+
+
+def test_oversized_payload_refused_both_sides():
+    # Sender refuses before any bytes hit the wire...
+    a, b = _pair()
+    with pytest.raises(FrameTooLarge):
+        send_frame(a, K_SEQS, b"x" * 100, max_frame_bytes=64)
+    # ...and the receiver refuses on the DECLARED length, before allocating
+    # or reading the payload (a corrupt header cannot OOM the learner).
+    a.sendall(encode_frame(K_SEQS, b"x" * 100))
+    with pytest.raises(FrameTooLarge):
+        recv_frame(b, max_frame_bytes=64)
+    a.close(), b.close()
+
+
+def test_bad_magic_raises():
+    a, b = _pair()
+    header = struct.Struct("!4sBQI").pack(b"NOPE", K_SEQS, 0, 0)
+    a.sendall(header)
+    with pytest.raises(FrameBadMagic):
+        recv_frame(b)
+    a.close(), b.close()
+
+
+def test_parse_address():
+    import socket as s
+
+    assert parse_address("127.0.0.1:7450") == (s.AF_INET, ("127.0.0.1", 7450))
+    assert parse_address("unix:/tmp/x.sock") == (s.AF_UNIX, "/tmp/x.sock")
+    with pytest.raises(ValueError, match="neither"):
+        parse_address("nonsense")
+
+
+def test_encode_frame_oversized_refused():
+    with pytest.raises(FrameTooLarge):
+        encode_frame(K_SEQS, b"x" * (transport.MAX_FRAME_BYTES + 1))
+
+
+def test_param_version_regression_ignored():
+    """The actor applies monotonically increasing versions ONLY: a stale or
+    replayed PARAMS frame (reconnect races, delayed pushes) leaves the nets
+    at the newer snapshot."""
+    import jax
+
+    from r2d2dpg_tpu.configs import PENDULUM_TINY
+    from r2d2dpg_tpu.fleet.actor import FleetActor
+
+    actor = FleetActor(
+        PENDULUM_TINY,
+        actor_id=0,
+        num_actors=2,
+        address="127.0.0.1:1",  # never dialed: run() is not called
+        seed=0,
+    )
+
+    def snap(version):
+        scaled = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) * (1.0 + version),
+            jax.device_get(actor._train.actor_params),
+        )
+        return {
+            "version": version,
+            "params": {
+                "actor_params": scaled,
+                "critic_params": jax.device_get(actor._train.critic_params),
+                "target_actor_params": jax.device_get(
+                    actor._train.target_actor_params
+                ),
+                "target_critic_params": jax.device_get(
+                    actor._train.target_critic_params
+                ),
+            },
+        }
+
+    v2 = snap(2)
+    assert actor.maybe_apply_params(v2) is True
+    assert actor._param_version == 2
+    after_v2 = jax.tree_util.tree_leaves(actor._train.actor_params)[0]
+
+    # Stale (1 < 2), replayed (2 == 2): both ignored, nets untouched.
+    assert actor.maybe_apply_params(snap(1)) is False
+    assert actor.maybe_apply_params(v2) is False
+    assert actor._param_version == 2
+    np.testing.assert_array_equal(
+        jax.tree_util.tree_leaves(actor._train.actor_params)[0], after_v2
+    )
+
+    # Fresh version still applies.
+    assert actor.maybe_apply_params(snap(3)) is True
+    assert actor._param_version == 3
