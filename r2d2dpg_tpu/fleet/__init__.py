@@ -11,6 +11,8 @@ the untouched phase-locked schedule, pinned bit-identical by
 tests/test_fleet.py.
 
 - ``transport``  — length-prefixed CRC32 frames over TCP/Unix sockets.
+- ``wire``       — the zero-copy SEQS/PARAMS payload codec: schema-cached
+  binary tree format, negotiated bf16/compressed lanes (ISSUE 5).
 - ``actor``      — the worker-process collect loop + per-actor noise
   ladder slice (``python -m r2d2dpg_tpu.fleet.actor``).
 - ``ingest``     — ``IngestServer`` (N connections -> staging queue) and
@@ -28,6 +30,7 @@ from r2d2dpg_tpu.fleet.supervisor import (
     SupervisorConfig,
     default_actor_argv,
 )
+from r2d2dpg_tpu.fleet.wire import WireConfig
 
 __all__ = [
     "ActorSupervisor",
@@ -35,5 +38,6 @@ __all__ = [
     "FleetLearner",
     "IngestServer",
     "SupervisorConfig",
+    "WireConfig",
     "default_actor_argv",
 ]

@@ -28,13 +28,17 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import sys
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 
 from r2d2dpg_tpu.configs import CONFIGS, ExperimentConfig, get_config
+from r2d2dpg_tpu.fleet import wire
 from r2d2dpg_tpu.fleet.transport import (
+    HEADER_BYTES,
+    MAX_FRAME_BYTES,
     K_ACK,
     K_BYE,
     K_HELLO,
@@ -45,7 +49,7 @@ from r2d2dpg_tpu.fleet.transport import (
     pack_obj,
     recv_frame,
     send_frame,
-    to_host,
+    send_frame_parts,
     unpack_obj,
 )
 from r2d2dpg_tpu.obs import flight_event, get_registry, set_flight_identity
@@ -54,7 +58,11 @@ from r2d2dpg_tpu.replay.arena import StagedSequences
 from r2d2dpg_tpu.training.assembler import emit
 from r2d2dpg_tpu.training.pipeline import CollectorState, split_state
 from r2d2dpg_tpu.training.trainer import Trainer, TrainerConfig
-from r2d2dpg_tpu.utils.codes import SHED_INGEST
+from r2d2dpg_tpu.utils.codes import (
+    EXIT_WIRE_REFUSED,
+    REFUSED_WIRE,
+    SHED_INGEST,
+)
 
 
 class FleetActorTrainer(Trainer):
@@ -121,9 +129,20 @@ class FleetActor:
         num_actors: int,
         address: str,
         seed: Optional[int] = None,
+        wire_config: Optional[wire.WireConfig] = None,
+        max_frame_bytes: int = MAX_FRAME_BYTES,
     ):
         self.actor_id = actor_id
         self.address = address
+        # The wire fast lane (fleet/wire.py): must MIRROR the learner's
+        # --fleet-wire/--fleet-compress — the ingest server refuses a
+        # mismatched HELLO (one fleet, one wire format).
+        self.wire_config = (wire_config or wire.WireConfig()).validate()
+        # Frame ceiling: must mirror the learner's FleetConfig value too
+        # (the spawner forwards it) — a packer pinned to the default would
+        # FrameTooLarge-crash-loop a fleet configured for larger frames,
+        # and a larger actor ceiling would emit frames the server refuses.
+        self.max_frame_bytes = max_frame_bytes
         self.trainer = build_actor_trainer(
             exp, actor_index=actor_id, num_actors=num_actors
         )
@@ -163,6 +182,14 @@ class FleetActor:
         )
         self._obs_version = reg.gauge(
             "r2d2dpg_actor_param_version", "last applied param version"
+        )
+        self._obs_bytes_out = reg.counter(
+            "r2d2dpg_actor_bytes_out_total",
+            "bytes this actor put on the fleet wire (frames + headers)",
+        )
+        self._obs_bytes_in = reg.counter(
+            "r2d2dpg_actor_bytes_in_total",
+            "bytes this actor received off the fleet wire (acks + params)",
         )
 
     # ---------------------------------------------------------- device parts
@@ -240,18 +267,37 @@ class FleetActor:
         """Stream until the server goes away (orderly end) or a protocol
         error surfaces (crash — nonzero exit, the supervisor restarts)."""
         sock = connect(self.address)
+        # Wire state lives and dies with the socket: a reconnect gets a
+        # fresh packer whose first SEQS frame re-inlines its schema.
+        packer = wire.TreePacker(
+            self.wire_config, max_frame_bytes=self.max_frame_bytes
+        )
+        self._unpacker = wire.TreeUnpacker(
+            max_frame_bytes=self.max_frame_bytes
+        )
         try:
-            send_frame(
-                sock,
-                K_HELLO,
-                pack_obj(
-                    {
-                        "actor_id": self.actor_id,
-                        "num_envs": self.trainer.config.num_envs,
-                    }
-                ),
+            self._obs_bytes_out.inc(
+                send_frame(
+                    sock,
+                    K_HELLO,
+                    pack_obj(  # wire-lint: control
+                        {
+                            "actor_id": self.actor_id,
+                            "num_envs": self.trainer.config.num_envs,
+                            **wire.negotiation_fields(self.wire_config),
+                        }
+                    ),
+                    max_frame_bytes=self.max_frame_bytes,
+                )
             )
-            self._await_ack(sock)
+            hello_ack = self._await_ack(sock)
+            if hello_ack.get("code") == REFUSED_WIRE:
+                raise _WireRefused(
+                    f"ingest refused wire negotiation "
+                    f"({hello_ack.get('reason')}); launch this actor with "
+                    f"the learner's --fleet-wire/--fleet-compress "
+                    f"(server expects {hello_ack.get('expect')})"
+                )
             while max_phases is None or self._phase < max_phases:
                 staged = self.collect_phase()
                 if staged is None:
@@ -275,7 +321,10 @@ class FleetActor:
                 # monotone across incarnations (ingest just accumulates).
                 steps_delta = float(env_steps) - self._last_env_steps
                 self._last_env_steps = float(env_steps)
-                payload = pack_obj(
+                # The steady-state hot path: schema-cached binary frames
+                # (fleet/wire.py), tensor bytes streamed without an
+                # intermediate payload join (send_frame_parts).
+                parts = packer.pack(
                     {
                         "phase": self._phase,
                         "param_version": self._param_version,
@@ -287,13 +336,20 @@ class FleetActor:
                         ),
                     }
                 )
-                send_frame(sock, K_SEQS, payload)
+                self._obs_bytes_out.inc(
+                    send_frame_parts(
+                        sock,
+                        K_SEQS,
+                        parts,
+                        max_frame_bytes=self.max_frame_bytes,
+                    )
+                )
                 ack = self._await_ack(sock)
                 if ack["code"] == SHED_INGEST:
                     self._sheds += 1
                     self._obs_shed.inc()
             try:
-                send_frame(sock, K_BYE, b"")
+                send_frame(sock, K_BYE, b"")  # wire-lint: control
             except OSError:
                 pass
         finally:
@@ -307,12 +363,15 @@ class FleetActor:
         (the server orders PARAMS-then-ACK so a fresh snapshot is live
         before the next collect phase)."""
         while True:
-            kind, payload = recv_frame(sock)
+            kind, payload = recv_frame(
+                sock, max_frame_bytes=self.max_frame_bytes
+            )
+            self._obs_bytes_in.inc(HEADER_BYTES + len(payload))
             if kind == K_PARAMS:
-                self.maybe_apply_params(unpack_obj(payload))
+                self.maybe_apply_params(self._unpacker.unpack(payload))
                 continue
             if kind == K_ACK:
-                return unpack_obj(payload)
+                return unpack_obj(payload)  # wire-lint: control
             if kind == K_BYE:
                 raise _OrderlyShutdown()
             raise FrameError(f"unexpected frame kind {kind}")
@@ -320,6 +379,14 @@ class FleetActor:
 
 class _OrderlyShutdown(Exception):
     """Server said BYE mid-stream: exit 0, nothing crashed."""
+
+
+class _WireRefused(FrameError):
+    """HELLO refused: deterministic config mismatch, not a transient crash.
+
+    Exits with ``EXIT_WIRE_REFUSED`` so the supervisor gives the slot up
+    instead of crash-restarting a misconfigured actor forever (every
+    incarnation would be refused again within milliseconds)."""
 
 
 # ---------------------------------------------------------------------- CLI
@@ -361,6 +428,18 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--ladder-alpha", type=float, default=None)
     p.add_argument("--compute-dtype", default=None,
                    choices=["float32", "bfloat16"])
+    # Wire fast lane — must mirror the learner's --fleet-wire/
+    # --fleet-compress (the ingest server refuses a mismatched HELLO).
+    p.add_argument("--wire", default="f32", choices=list(wire.ENCODINGS),
+                   help="payload precision on the wire (bf16: observations/"
+                   "carries/params downcast; rewards/priorities stay f32)")
+    p.add_argument("--compress", default="none",
+                   choices=list(wire.COMPRESSIONS),
+                   help="frame compression (zstd only where the zstandard "
+                   "module is installed)")
+    p.add_argument("--max-frame-bytes", type=int, default=MAX_FRAME_BYTES,
+                   help="frame ceiling — must mirror the learner's "
+                   "FleetConfig.max_frame_bytes (the spawner forwards it)")
     p.add_argument("--flight-path", default=None,
                    help="dump this actor's flight ring here on exit")
     return p.parse_args(argv)
@@ -398,12 +477,20 @@ def main(argv=None) -> None:
 
         get_flight_recorder().install(args.flight_path)
     exp = _apply_overrides(get_config(args.config), args)
+    try:
+        wire_config = wire.WireConfig(
+            encoding=args.wire, compress=args.compress
+        ).validate()
+    except ValueError as e:
+        raise SystemExit(f"fleet actor {args.actor_id}: --compress: {e}")
     actor = FleetActor(
         exp,
         actor_id=args.actor_id,
         num_actors=args.num_actors,
         address=args.connect,
         seed=args.seed,
+        wire_config=wire_config,
+        max_frame_bytes=args.max_frame_bytes,
     )
     flight_event("actor_start", phase=0, address=args.connect)
     try:
@@ -411,6 +498,18 @@ def main(argv=None) -> None:
     except _OrderlyShutdown:
         # The server said BYE: the learner is done — exit 0, nothing broke.
         flight_event("actor_disconnect", phase=actor._phase)
+    except _WireRefused as e:
+        # Deterministic misconfiguration — a restart would be refused
+        # again within milliseconds.  Exit with the dedicated code so the
+        # supervisor gives this slot up instead of crash-looping it.
+        err = f"{type(e).__name__}: {e}"
+        flight_event("actor_wire_refused", phase=actor._phase, error=err)
+        print(  # obs-lint: allow — CLI entrypoint, routed to the actor log
+            f"fleet actor {args.actor_id}: {err}",
+            file=sys.stderr,
+            flush=True,
+        )
+        raise SystemExit(EXIT_WIRE_REFUSED)
     except (FrameError, OSError) as e:
         # Anything else — refused connect, CRC violation, torn stream — is
         # a CRASH per this module's contract: record the actual error

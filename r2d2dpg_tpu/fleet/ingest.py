@@ -12,7 +12,8 @@ connection's ONLY writer, so acks and param pushes never interleave):
 
     actor                          ingest handler
     -----                          --------------
-    HELLO {actor_id}          ->
+    HELLO {actor_id, wire...} ->        (wire mismatch: ACK refused_wire
+                                         + close — fleet/wire.py)
                               <-   [PARAMS {version, params}]   (if any)
                               <-   ACK {code: ok, param_version}
     SEQS {staged, stats}      ->   staging_queue.put (bounded wait)
@@ -45,8 +46,9 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import numpy as np
 
-from r2d2dpg_tpu.fleet import transport
+from r2d2dpg_tpu.fleet import transport, wire
 from r2d2dpg_tpu.fleet.transport import (
+    HEADER_BYTES,
     K_ACK,
     K_BYE,
     K_HELLO,
@@ -60,15 +62,16 @@ from r2d2dpg_tpu.fleet.transport import (
     unpack_obj,
 )
 from r2d2dpg_tpu.obs import flight_event, get_registry
-from r2d2dpg_tpu.replay.arena import StagedSequences
+from r2d2dpg_tpu.replay.arena import stack_staged
 from r2d2dpg_tpu.training.pipeline import (
     LearnerState,
+    coalesce_from_queue,
     drain_staged,
     merge_state,
     split_state,
 )
 from r2d2dpg_tpu.training.trainer import Trainer, TrainerState
-from r2d2dpg_tpu.utils.codes import OK, SHED_INGEST
+from r2d2dpg_tpu.utils.codes import OK, REFUSED_WIRE, SHED_INGEST
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,8 +84,20 @@ class FleetConfig:
     publish_every: int = 1  # drain phases between param publications
     prefetch: bool = True  # double-buffered sampling in the drain program
     shed_after_s: float = 1.0  # handler waits this long before shedding
+    # Sheds are suppressed (handlers wait this long instead) until the
+    # first drain-learn has EXECUTED: the drain program's one-time compile
+    # takes tens of seconds on a small host, long enough that every
+    # actor's pending put used to time out exactly once — the historical
+    # "sheds == num_actors" startup artifact (docs/FLEET.md).
+    startup_shed_grace_s: float = 120.0
     idle_timeout_s: float = 300.0  # no batch for this long = starved, abort
     max_frame_bytes: int = transport.MAX_FRAME_BYTES
+    # The wire fast lane (fleet/wire.py): one encoding/compression per
+    # fleet, negotiated at HELLO; actors with a different lane are refused.
+    wire: wire.WireConfig = wire.WireConfig()
+    # Max queued staged batches stacked into ONE compiled drain call (the
+    # arena-add dispatch amortization); 1 = today's one-call-per-batch.
+    drain_coalesce: int = 1
 
 
 class IngestServer:
@@ -94,12 +109,33 @@ class IngestServer:
         *,
         address: str = "127.0.0.1:0",
         shed_after_s: float = 1.0,
+        startup_shed_grace_s: float = 120.0,
         max_frame_bytes: int = transport.MAX_FRAME_BYTES,
+        wire_config: Optional[wire.WireConfig] = None,
     ):
         self.queue = staging_queue
         self._request_address = address
         self.shed_after_s = shed_after_s
+        self.startup_shed_grace_s = startup_shed_grace_s
         self.max_frame_bytes = max_frame_bytes
+        self.wire_config = (wire_config or wire.WireConfig()).validate()
+        # Param snapshots are packed once per version and broadcast to all
+        # handlers, so every frame inlines its schema — a freshly
+        # reconnected (restarted) actor must decode it standalone.
+        self._params_packer = wire.TreePacker(
+            self.wire_config,
+            always_inline=True,
+            max_frame_bytes=max_frame_bytes,
+        )
+        # Until the first drain-learn executes (mark_steady), handlers
+        # wait out the learner's compile instead of shedding (FleetConfig.
+        # startup_shed_grace_s — the sheds==num_actors warmup artifact).
+        # The grace also SELF-EXPIRES startup_shed_grace_s after the first
+        # successful queue hand-off, so an embedder that consumes the
+        # queue itself (IngestServer is public) and never calls
+        # mark_steady still gets its configured shed_after_s back.
+        self._steady = threading.Event()
+        self._first_put_at: Optional[float] = None
         self.address: Optional[str] = None  # resolved at start()
         self._unix_path: Optional[str] = None
         self._listener: Optional[socket.socket] = None
@@ -110,14 +146,20 @@ class IngestServer:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         # Latest published params: raw host trees swapped in by the drain
-        # thread (cheap), packed ONCE per version on the first handler push
-        # (_params_snapshot) — neither the drain thread nor later pushes
-        # pay the pickle.
+        # thread (cheap), packed ONCE per version — in the negotiated wire
+        # encoding — on the first handler push (_params_snapshot); neither
+        # the drain thread nor later pushes pay the pack.
         self._params_obj: Optional[Any] = None
         self._params_frame: Optional[bytes] = None
         self._param_version = 0
         self.shed_total = 0
         self.seqs_total = 0
+        # Wire accounting (all SEQS frames, shed or not; under _lock):
+        # bytes as received vs their declared decompressed size — the
+        # bench probe's bytes-on-wire and compression-ratio columns.
+        self.seqs_received_total = 0
+        self.seqs_bytes_total = 0
+        self.seqs_raw_bytes_total = 0
         # Scalar stats riding a shed SEQS message: the EXPERIENCE may be
         # dropped under pressure, but the episode/step accounting must not
         # be (the actor already drained its accumulators) — banked here,
@@ -151,6 +193,21 @@ class IngestServer:
             "r2d2dpg_fleet_actors_connected", "live actor connections"
         )
         self._obs_connected.set_fn(lambda: float(len(self._conns)))
+        self._obs_bytes_in = reg.counter(
+            "r2d2dpg_fleet_bytes_in_total",
+            "bytes received off the fleet wire (frames + headers)",
+            labelnames=("actor",),
+        )
+        self._obs_bytes_out = reg.counter(
+            "r2d2dpg_fleet_bytes_out_total",
+            "bytes sent on the fleet wire (acks + param pushes)",
+            labelnames=("actor",),
+        )
+        self._obs_ratio = reg.gauge(
+            "r2d2dpg_fleet_compress_ratio",
+            "declared decompressed size over received payload size of the "
+            "last SEQS frame (1.0 = uncompressed wire)",
+        )
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "IngestServer":
@@ -242,6 +299,12 @@ class IngestServer:
         for t in list(self._handlers):
             t.join(timeout=5)
 
+    def mark_steady(self) -> None:
+        """Startup is over (the drain loop's first compiled drain-learn
+        has executed): from here on, queue-full waits shed after
+        ``shed_after_s`` instead of the startup grace."""
+        self._steady.set()
+
     # ---------------------------------------------------------------- params
     def publish_params(self, version: int, params: Any) -> None:
         """Swap in a new versioned param snapshot (numpy trees; callers use
@@ -255,14 +318,18 @@ class IngestServer:
 
     def _params_snapshot(self):
         """Lazy pack on the FIRST push (a handler thread), once per
-        version; the pickle itself runs OUTSIDE the server lock so other
-        handlers' acks and the drain thread's publishes never stall on
-        it."""
+        version, in the negotiated wire encoding (fleet/wire.py — bf16
+        params cross at half the bytes); the pack itself runs OUTSIDE the
+        server lock so other handlers' acks and the drain thread's
+        publishes never stall on it.  The packed payload is one bytes
+        object broadcast to every handler thread."""
         with self._lock:
             version = self._param_version
             frame, obj = self._params_frame, self._params_obj
         if frame is None and obj is not None:
-            frame = pack_obj({"version": version, "params": obj})
+            frame = b"".join(
+                self._params_packer.pack({"version": version, "params": obj})
+            )
             with self._lock:
                 if self._param_version == version and self._params_frame is None:
                     self._params_frame = frame
@@ -312,41 +379,121 @@ class IngestServer:
             t.start()
 
     def _push_params_if_stale(
-        self, conn: socket.socket, sent_version: int
+        self, conn: socket.socket, sent_version: int, bytes_out
     ) -> int:
         version, frame = self._params_snapshot()
         if frame is not None and version > sent_version:
-            send_frame(
-                conn, K_PARAMS, frame, max_frame_bytes=self.max_frame_bytes
+            bytes_out.inc(
+                send_frame(
+                    conn,
+                    K_PARAMS,
+                    frame,
+                    max_frame_bytes=self.max_frame_bytes,
+                )
             )
             return version
         return sent_version
 
+    def _put_or_shed(self, msg) -> bool:
+        """Bounded-wait enqueue: True = queued, False = shed.
+
+        The bound is ``shed_after_s`` once the drain loop marks steady —
+        or once the grace window has elapsed since the FIRST hand-off
+        (the self-expiry for embedders that never mark) — and the
+        startup grace before that (the first drain-learn's compile must
+        not cost every actor one shed).  The wait runs in short slices
+        so a stopping server (learner aborted mid-compile) reclaims its
+        handlers in ~a slice, not after a monolithic 120 s ``queue.put``
+        that ignores ``_stop``."""
+        now = time.monotonic()
+        in_grace = not self._steady.is_set() and (
+            self._first_put_at is None
+            or now - self._first_put_at < self.startup_shed_grace_s
+        )
+        if in_grace:
+            # Anchor the deadline at the END of the grace window (first
+            # hand-off + grace), not now + grace: a wait that begins just
+            # inside the window must not stretch the window to ~2x; it
+            # gets its shed_after_s past the expiry and no more.
+            anchor = now if self._first_put_at is None else self._first_put_at
+            deadline = max(
+                now + self.shed_after_s,
+                anchor + self.startup_shed_grace_s,
+            )
+        else:
+            deadline = now + self.shed_after_s
+        while not self._stop.is_set():
+            try:
+                self.queue.put(
+                    msg,
+                    timeout=min(0.25, max(deadline - time.monotonic(), 0.0)),
+                )
+                if self._first_put_at is None:
+                    self._first_put_at = time.monotonic()
+                return True
+            except queue.Full:
+                if time.monotonic() >= deadline:
+                    return False
+        return False  # stopping: drop silently, the run is over
+
     def _handle(self, ident: int, conn: socket.socket) -> None:
         actor = "?"
+        # Per-connection wire state: the peer's packer lives on its side
+        # of this socket, so the schema cache must die with it too.
+        unpacker = wire.TreeUnpacker(max_frame_bytes=self.max_frame_bytes)
         try:
             kind, payload = recv_frame(
                 conn, max_frame_bytes=self.max_frame_bytes
             )
             if kind != K_HELLO:
                 raise FrameError(f"expected HELLO, got kind {kind}")
-            hello = unpack_obj(payload)
+            hello = unpack_obj(payload)  # wire-lint: control
             actor = str(hello.get("actor_id", "?"))
-            sent_version = self._push_params_if_stale(conn, 0)
-            send_frame(
-                conn,
-                K_ACK,
-                pack_obj({"code": OK, "param_version": sent_version}),
+            bytes_in = self._obs_bytes_in.labels(actor=actor)
+            bytes_out = self._obs_bytes_out.labels(actor=actor)
+            bytes_in.inc(HEADER_BYTES + len(payload))
+            mismatch = wire.check_negotiation(hello, self.wire_config)
+            if mismatch is not None:
+                # One fleet, one wire format: a mismatched actor would
+                # poison every SEQS decode — refuse at the door, loudly.
+                flight_event("wire_refused", actor=actor, reason=mismatch)
+                bytes_out.inc(
+                    send_frame(
+                        conn,
+                        K_ACK,
+                        pack_obj(  # wire-lint: control
+                            {
+                                "code": REFUSED_WIRE,
+                                "param_version": 0,
+                                "reason": mismatch,
+                                "expect": wire.negotiation_fields(
+                                    self.wire_config
+                                ),
+                            }
+                        ),
+                    )
+                )
+                return
+            sent_version = self._push_params_if_stale(conn, 0, bytes_out)
+            bytes_out.inc(
+                send_frame(
+                    conn,
+                    K_ACK,
+                    pack_obj(  # wire-lint: control
+                        {"code": OK, "param_version": sent_version}
+                    ),
+                )
             )
             while not self._stop.is_set():
                 kind, payload = recv_frame(
                     conn, max_frame_bytes=self.max_frame_bytes
                 )
+                bytes_in.inc(HEADER_BYTES + len(payload))
                 if kind == K_BYE:
                     return
                 if kind != K_SEQS:
                     raise FrameError(f"expected SEQS/BYE, got kind {kind}")
-                msg = unpack_obj(payload)
+                msg = unpacker.unpack(payload)
                 msg["actor_id"] = actor
                 n_seqs = int(
                     np.shape(msg["staged"].seq.reward)[0]
@@ -356,12 +503,21 @@ class IngestServer:
                 self._obs_staleness.labels(actor=actor).set(
                     self._param_version - int(msg.get("param_version", 0))
                 )
-                try:
-                    self.queue.put(msg, timeout=self.shed_after_s)
+                self._obs_ratio.set(
+                    unpacker.last_raw_len
+                    / max(unpacker.last_payload_len, 1)
+                )
+                with self._lock:
+                    self.seqs_received_total += n_seqs
+                    self.seqs_bytes_total += HEADER_BYTES + len(payload)
+                    self.seqs_raw_bytes_total += unpacker.last_raw_len
+                if self._put_or_shed(msg):
                     code = OK
                     with self._lock:  # N handler threads share these sums
                         self.seqs_total += n_seqs
-                except queue.Full:
+                else:
+                    if self._stop.is_set():
+                        return
                     code = SHED_INGEST
                     with self._lock:
                         self.shed_total += 1
@@ -372,11 +528,17 @@ class IngestServer:
                         "shed", code=code, actor=actor,
                         phase=int(msg.get("phase", -1)),
                     )
-                sent_version = self._push_params_if_stale(conn, sent_version)
-                send_frame(
-                    conn,
-                    K_ACK,
-                    pack_obj({"code": code, "param_version": sent_version}),
+                sent_version = self._push_params_if_stale(
+                    conn, sent_version, bytes_out
+                )
+                bytes_out.inc(
+                    send_frame(
+                        conn,
+                        K_ACK,
+                        pack_obj(  # wire-lint: control
+                            {"code": code, "param_version": sent_version}
+                        ),
+                    )
                 )
         except (FrameError, OSError) as e:
             if not self._stop.is_set():
@@ -419,6 +581,9 @@ class FleetLearner:
             )
         if config.queue_depth < 1:
             raise ValueError("queue_depth must be >= 1")
+        if config.drain_coalesce < 1:
+            raise ValueError("drain_coalesce must be >= 1")
+        config.wire.validate()
         self.trainer = trainer
         self.config = config
         self.queue: "queue.Queue" = queue.Queue(maxsize=config.queue_depth)
@@ -426,7 +591,9 @@ class FleetLearner:
             self.queue,
             address=config.address,
             shed_after_s=config.shed_after_s,
+            startup_shed_grace_s=config.startup_shed_grace_s,
             max_frame_bytes=config.max_frame_bytes,
+            wire_config=config.wire,
         )
         self._drain_prog = jax.jit(
             lambda ls, st: drain_staged(
@@ -447,6 +614,10 @@ class FleetLearner:
         self.learner_wait = reg.histogram(
             "r2d2dpg_fleet_learner_wait_seconds",
             "learner thread blocked on the fleet staging queue (starvation)",
+        )
+        self._obs_coalesce = reg.gauge(
+            "r2d2dpg_fleet_drain_coalesce_width",
+            "staged batches stacked into the most recent compiled drain",
         )
         self._stats: Dict[str, float] = {}
 
@@ -525,13 +696,15 @@ class FleetLearner:
                 + " ".join(f"{k} {v:.3g}" for k, v in scalars.items())
             )
 
+        coalesce_sum = 0
+        coalesce_n = 0
         try:
             while drained < num_train_phases:
                 if deadline is not None and time.monotonic() >= deadline:
                     break
                 t_wait = time.monotonic()
                 try:
-                    msg = self.queue.get(timeout=0.5)
+                    first = self.queue.get(timeout=0.5)
                 except queue.Empty:
                     self.learner_wait.add(time.monotonic() - t_wait)
                     # Cold-start grace: the FIRST batch pays actor
@@ -550,6 +723,18 @@ class FleetLearner:
                     continue
                 self.learner_wait.add(time.monotonic() - t_wait)
                 last_batch_t = time.monotonic()
+                # Coalesced drain (drain_coalesce): the blocking-got batch
+                # plus whatever backlog the queue ALREADY holds, stacked
+                # into ONE compiled call — the arena-add dispatch is paid
+                # once per backlog instead of once per actor batch.  A
+                # keeping-up learner sees width 1 and the uncoalesced
+                # schedule exactly.
+                msgs = coalesce_from_queue(
+                    self.queue, first, self.config.drain_coalesce
+                )
+                coalesce_sum += len(msgs)
+                coalesce_n += 1
+                self._obs_coalesce.set(float(len(msgs)))
                 # Fold shed-banked accounting EVERY iteration (a cheap
                 # locked dict swap): only the experience of a shed message
                 # was droppable, and the sums must be right whenever read
@@ -558,11 +743,12 @@ class FleetLearner:
                 env_steps_total += shed_stats["env_steps_delta"]
                 ep_ret_sum += shed_stats["ep_return_sum"]
                 ep_count += shed_stats["ep_count"]
-                staged: StagedSequences = msg["staged"]
+                staged = stack_staged([m["staged"] for m in msgs])
                 n_seqs = int(np.shape(staged.seq.reward)[0])
-                ep_ret_sum += float(msg.get("ep_return_sum", 0.0))
-                ep_count += float(msg.get("ep_count", 0.0))
-                env_steps_total += float(msg.get("env_steps_delta", 0.0))
+                for msg in msgs:
+                    ep_ret_sum += float(msg.get("ep_return_sum", 0.0))
+                    ep_count += float(msg.get("ep_count", 0.0))
+                    env_steps_total += float(msg.get("env_steps_delta", 0.0))
                 absorbed += n_seqs
                 # staged_writer around the COMPILED call: inside the jit
                 # the arena's own guard only runs at trace time, so the
@@ -581,6 +767,9 @@ class FleetLearner:
                     jax.block_until_ready(lstate.train.step)
                     train_t0 = time.monotonic()
                     seqs_at_train_t0 = absorbed
+                    # Startup is over: handlers now shed on the real
+                    # shed_after_s bound instead of the compile grace.
+                    self.server.mark_steady()
                 if drained % max(self.config.publish_every, 1) == 0:
                     version += 1
                     self.server.publish_params(
@@ -610,6 +799,7 @@ class FleetLearner:
             jax.block_until_ready(lstate.train.step)
             wall = max(time.monotonic() - t0, 1e-9)
             _, lw_total, lw_p50, lw_p99 = self.learner_wait.snapshot()
+            srv = self.server
             self._stats = {
                 "train_phases": float(drained),
                 "absorbed_seqs": float(absorbed),
@@ -622,6 +812,18 @@ class FleetLearner:
                 "learner_wait_p50_ms": lw_p50 * 1e3,
                 "learner_wait_p99_ms": lw_p99 * 1e3,
                 "learner_wait_total_s": lw_total,
+                # Wire accounting (docs/FLEET.md "Wire format"): frame
+                # bytes as received vs the declared decompressed size.
+                "bytes_in_total": float(srv.seqs_bytes_total),
+                "bytes_per_seq": (
+                    srv.seqs_bytes_total / max(srv.seqs_received_total, 1)
+                ),
+                "wire_ratio": (
+                    srv.seqs_raw_bytes_total / max(srv.seqs_bytes_total, 1)
+                ),
+                "drain_coalesce_width_mean": (
+                    coalesce_sum / max(coalesce_n, 1)
+                ),
             }
             if train_t0 is not None:
                 # Steady-state window rates (the bench probe's keys): the

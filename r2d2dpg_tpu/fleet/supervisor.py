@@ -28,6 +28,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from r2d2dpg_tpu.obs import flight_event
+from r2d2dpg_tpu.utils.codes import EXIT_WIRE_REFUSED
 
 
 @dataclasses.dataclass(frozen=True)
@@ -193,6 +194,20 @@ class ActorSupervisor:
                             restarts=slot.restarts,
                             backoff_s=round(backoff, 3),
                         )
+                        if rc == EXIT_WIRE_REFUSED:
+                            # Deterministic wire-negotiation mismatch:
+                            # every restart would be refused again within
+                            # milliseconds (healthy_after_s never resets
+                            # the ladder) — give the slot up NOW with a
+                            # terminal event instead of churning forever.
+                            slot.gave_up = True
+                            flight_event(
+                                "actor_gave_up",
+                                actor=actor_id,
+                                restarts=slot.restarts,
+                                reason="wire_refused",
+                            )
+                            continue
                         if (
                             cfg.max_restarts is not None
                             and slot.restarts >= cfg.max_restarts

@@ -18,12 +18,15 @@ byte stream — localhost TCP (``"host:port"``) or a Unix domain socket
 - **EOF mid-frame** raises ``FrameTruncated`` — a half-written frame from a
   crashed actor never silently becomes a short payload.
 
-Payloads are pickled Python objects (protocol 4): the pytrees crossing the
-wire (``replay.StagedSequences`` with numpy leaves, param snapshots) are
-registered dataclasses that round-trip natively.  Integrity, not
-authentication — both ends are subprocesses of one trusted training run on
-one host (the supervisor spawns the actors); never point an ingest server
-at an untrusted network.
+Payload encoding is per frame KIND: control frames (HELLO/ACK/BYE) carry
+small pickled dicts (``pack_obj``/``unpack_obj`` — annotated call sites
+only; ``scripts/lint_fleet_wire.sh`` enforces the whitelist), while the
+steady-state tensor frames (SEQS/PARAMS) carry the zero-copy binary
+format of ``fleet/wire.py`` — schema-cached headers plus raw contiguous
+tensor bytes, sent without intermediate copies via ``send_frame_parts``.
+Integrity, not authentication — both ends are subprocesses of one trusted
+training run on one host (the supervisor spawns the actors); never point
+an ingest server at an untrusted network.
 
 Backpressure is explicit, not buffered: ``send_frame`` uses a blocking
 ``sendall`` on a socket whose send buffer is clamped small
@@ -108,14 +111,56 @@ def send_frame(
     payload: bytes,
     *,
     max_frame_bytes: int = MAX_FRAME_BYTES,
-) -> None:
-    """Blocking framed send; the blocking IS the backpressure (module doc)."""
+) -> int:
+    """Blocking framed send; the blocking IS the backpressure (module doc).
+    Returns total bytes on the wire (header + payload) for obs counters."""
     if len(payload) > max_frame_bytes:
         raise FrameTooLarge(
             f"payload {len(payload)}B exceeds frame ceiling {max_frame_bytes}B"
         )
     sock.sendall(_HEADER.pack(MAGIC, kind, len(payload), zlib.crc32(payload)))
     sock.sendall(payload)
+    return HEADER_BYTES + len(payload)
+
+
+def send_frame_parts(
+    sock: socket.socket,
+    kind: int,
+    parts,
+    *,
+    max_frame_bytes: int = MAX_FRAME_BYTES,
+) -> int:
+    """Framed send of a multi-part payload WITHOUT joining it first.
+
+    ``fleet/wire.py`` hands tensor bytes as memoryviews straight into the
+    arrays being sent; joining them into one payload would re-copy every
+    tensor byte — the exact copy the zero-copy wire exists to avoid.  The
+    CRC runs incrementally over the parts, then header + parts go out as
+    ONE scatter-gather ``sendmsg`` (a per-part ``sendall`` would be a
+    dozen syscalls per frame, each tiny scalar slot flushing as its own
+    TCP_NODELAY segment).  Returns total bytes on the wire."""
+    total = sum(len(p) for p in parts)
+    if total > max_frame_bytes:
+        raise FrameTooLarge(
+            f"payload {total}B exceeds frame ceiling {max_frame_bytes}B"
+        )
+    crc = 0
+    for p in parts:
+        crc = zlib.crc32(p, crc)
+    header = _HEADER.pack(MAGIC, kind, total, crc)
+    pending = [memoryview(header)] + [memoryview(p) for p in parts]
+    while pending:
+        # Blocking sendmsg may still send PARTIALLY (socket buffers are
+        # deliberately clamped small here); advance through the iovec.
+        # The slice keeps many-leaf trees (param snapshots) under the
+        # kernel's IOV_MAX.
+        sent = sock.sendmsg(pending[:512])
+        while pending and sent >= len(pending[0]):
+            sent -= len(pending[0])
+            pending.pop(0)
+        if sent:
+            pending[0] = pending[0][sent:]
+    return HEADER_BYTES + total
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -155,7 +200,12 @@ def recv_frame(
 
 # ----------------------------------------------------------------- payloads
 def pack_obj(obj: Any) -> bytes:
-    """Serialize one message payload (numpy-leaved pytrees, dicts)."""
+    """Serialize one CONTROL-frame payload (HELLO/ACK/BYE dicts).
+
+    Pickle is banned from the SEQS/PARAMS steady-state paths
+    (``scripts/lint_fleet_wire.sh``): tensor payloads go through
+    ``fleet/wire.py``.  Control frames are small trusted dicts exchanged a
+    handful of times per phase — pickle's flexibility is fine there."""
     return pickle.dumps(obj, protocol=4)
 
 

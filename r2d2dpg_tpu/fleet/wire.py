@@ -1,0 +1,615 @@
+"""Zero-copy wire codec for the fleet's SEQS/PARAMS payloads (ISSUE 5).
+
+The original fleet wire (PR 4) pickled full-f32 numpy pytrees per frame —
+fine for a smoke test, but the Ape-X topology (PAPERS.md 1803.00933) lives
+on experience/param throughput, and pickle pays a full serialize +
+deserialize copy of every tensor byte on both ends of every frame.  This
+module replaces it on the steady-state path with a schema-cached binary
+format:
+
+::
+
+    payload := wire_header | [schema] | body
+    wire_header (16B, "!BBBBIQ"):
+        version (1B) | compress (1B) | flags (1B) | reserved (1B)
+        schema_id (u32 = crc32 of the schema JSON)
+        raw_len   (u64 = DECOMPRESSED body length)
+    schema (present iff flags bit 0): u32 length + compact JSON describing
+        tree structure + per-leaf dtypes/shapes.  Scalars (phase counters,
+        episode deltas) live in the BODY (8B each), so the schema is
+        byte-identical across a connection's frames and is sent ONCE —
+        steady-state frames carry a 4-byte id reference instead.
+    body := the leaves' raw little-endian bytes, depth-first, contiguous
+        (optionally zlib/zstd-compressed as one block).
+
+Decode allocates nothing per tensor: each array is a ``np.frombuffer``
+view straight into the received payload (read-only — the drain program's
+``device_put`` is the first and only copy).  Encode hands the socket a
+list of buffer views (``transport.send_frame_parts``) so tensor bytes are
+never joined into an intermediate payload copy either.
+
+**Precision** (negotiated at HELLO, one setting per fleet): ``f32`` puts
+every leaf on the wire in its storage dtype — bit-exact, the default and
+the determinism anchor.  ``bf16`` downcasts float32 leaves to bfloat16 on
+the wire and restores float32 on receive, EXCEPT the leaves named in
+``F32_PINNED_LEAVES`` (rewards and discounts feed return targets;
+priorities feed the sampling distribution — all stay exact).  A bf16 fleet trades ~2x wire
+bytes for ~3 decimal digits on observations/actions/carries/params — a
+*different, equally valid* trajectory, same class as the fleet's other
+nondeterminism (docs/FLEET.md "Precision caveats").
+
+**Zip-bomb guard**: the frame ceiling is enforced against the DECLARED
+DECOMPRESSED length (``raw_len``) before any allocation or decompression,
+and the decompressor is hard-capped at ``raw_len`` output bytes — a
+malicious or corrupt 1 KiB frame cannot balloon into an OOM.  A declared
+length the stream does not actually produce (either direction) is a
+``WireFormatError``.
+
+Both ends are subprocesses of one trusted run (transport.py's integrity
+model), but unlike pickle this codec is also *safe* to point at untrusted
+bytes: the schema walk can only ever build numpy views and plain
+scalars — there is no object construction to hijack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from r2d2dpg_tpu.fleet.transport import (
+    MAX_FRAME_BYTES,
+    FrameError,
+    FrameTooLarge,
+)
+from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
+
+WIRE_VERSION = 1
+
+ENC_F32 = "f32"
+ENC_BF16 = "bf16"
+ENCODINGS = (ENC_F32, ENC_BF16)
+
+COMP_NONE = "none"
+COMP_ZLIB = "zlib"
+COMP_ZSTD = "zstd"
+COMPRESSIONS = (COMP_NONE, COMP_ZLIB, COMP_ZSTD)
+
+try:  # optional: this container ships zlib only; negotiation refuses zstd
+    import zstandard as _zstd  # type: ignore
+except ImportError:  # pragma: no cover - environment-dependent
+    _zstd = None
+
+
+def available_compressions() -> Tuple[str, ...]:
+    """The compressions THIS process can actually run (zstd is gated on the
+    optional ``zstandard`` module; zlib is stdlib and always there)."""
+    out: Tuple[str, ...] = (COMP_NONE, COMP_ZLIB)
+    if _zstd is not None:
+        out += (COMP_ZSTD,)
+    return out
+
+
+class WireFormatError(FrameError):
+    """Payload violates the wire codec (malformed header/schema/body)."""
+
+
+# Leaves that keep their storage dtype even on a bf16 wire: rewards and
+# discounts feed n-step return targets (dm_control emits FRACTIONAL
+# discounts, not just 0/1 masks) and priorities feed the sampling CDF —
+# quantizing any of them changes WHAT is learned, not just how precisely
+# states are seen.
+F32_PINNED_LEAVES = frozenset({"reward", "discount", "priorities"})
+
+_PAYLOAD_HEADER = struct.Struct("!BBBBIQ")
+_SCHEMA_LEN = struct.Struct("!I")
+_F64 = struct.Struct("<d")
+_I64 = struct.Struct("<q")
+_FLAG_SCHEMA_INLINE = 1
+_COMP_CODES = {COMP_NONE: 0, COMP_ZLIB: 1, COMP_ZSTD: 2}
+_COMP_NAMES = {v: k for k, v in _COMP_CODES.items()}
+# Arrays at least this big go on the socket as memoryviews (zero-copy);
+# smaller ones (and 0-d scalar arrays) are cheaper to copy than to track.
+_VIEW_MIN_BYTES = 4096
+# Receiver-side schema cache bound: a well-behaved fleet uses a handful of
+# schemas per connection, so the cap only bites a peer streaming endless
+# DISTINCT inline schemas — which would otherwise grow the unpacker's
+# memory without bound (the same OOM class the raw_len ceiling closes).
+_SCHEMA_CACHE_MAX = 64
+
+HEADER_BYTES = _PAYLOAD_HEADER.size
+
+
+@dataclasses.dataclass(frozen=True)
+class WireConfig:
+    """The negotiated fast-lane shape: one per fleet, agreed at HELLO."""
+
+    encoding: str = ENC_F32
+    compress: str = COMP_NONE
+    zlib_level: int = 1  # speed over ratio: the wire is a hot path
+
+    def validate(self) -> "WireConfig":
+        if self.encoding not in ENCODINGS:
+            raise ValueError(
+                f"wire encoding {self.encoding!r} not in {ENCODINGS}"
+            )
+        if self.compress not in COMPRESSIONS:
+            raise ValueError(
+                f"wire compression {self.compress!r} not in {COMPRESSIONS}"
+            )
+        if self.compress not in available_compressions():
+            raise ValueError(
+                f"wire compression {self.compress!r} is not available in "
+                f"this environment (no zstandard module); have "
+                f"{available_compressions()}"
+            )
+        return self
+
+
+def negotiation_fields(config: WireConfig) -> Dict[str, Any]:
+    """The HELLO fields both ends compare (fleet/ingest.py refuses a
+    mismatch with ``utils.codes.REFUSED_WIRE`` — one fleet, one wire)."""
+    return {
+        "wire_version": WIRE_VERSION,
+        "encoding": config.encoding,
+        "compress": config.compress,
+    }
+
+
+def check_negotiation(hello: Dict[str, Any], config: WireConfig) -> Optional[str]:
+    """Compare an actor's HELLO against the learner's wire config; returns
+    a human-readable mismatch description, or None when compatible.
+
+    A HELLO without negotiation keys (a pre-wire actor) reads as
+    wire_version 0 and is ALWAYS refused — old actors speak pickled SEQS
+    frames this codec cannot decode, so there is no legacy acceptance
+    path, only a refusal that names the version gap."""
+    got_version = hello.get("wire_version", 0)
+    if got_version != WIRE_VERSION:
+        return f"wire_version {got_version} != {WIRE_VERSION}"
+    got_enc = hello.get("encoding", ENC_F32)
+    if got_enc != config.encoding:
+        return f"encoding {got_enc!r} != negotiated {config.encoding!r}"
+    got_comp = hello.get("compress", COMP_NONE)
+    if got_comp != config.compress:
+        return f"compress {got_comp!r} != negotiated {config.compress!r}"
+    return None
+
+
+# ---------------------------------------------------------------- dtypes
+def _bf16_dtype() -> np.dtype:
+    import ml_dtypes  # a jax dependency, always present next to it
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    if name == "bfloat16":
+        return _bf16_dtype()
+    try:
+        dt = np.dtype(name)
+    except TypeError as e:
+        raise WireFormatError(f"unknown wire dtype {name!r}: {e}")
+    if dt.hasobject:
+        raise WireFormatError(f"refusing object dtype {name!r} on the wire")
+    return dt
+
+
+# ------------------------------------------------------------------ pack
+def _describe(obj: Any, path: Tuple[str, ...], encoding: str, leaves: List):
+    """Walk one payload tree: append leaf records, return the schema node.
+
+    Schema nodes are deliberately tiny JSON: ``"n"``/``"i"``/``"f"``/
+    ``"t"`` for None/int/float/bool, ``{"d": [[key, child], ...]}`` for
+    dicts, ``{"S": [seq, priorities]}`` / ``{"B": [six fields]}`` for the
+    two registered fleet dataclasses, ``{"a": [storage, wire, shape]}``
+    for arrays.  Scalar VALUES go in the body (8B slots), so the schema —
+    and therefore its crc32 id — is stable across a run's frames."""
+    if obj is None:
+        return "n"
+    if isinstance(obj, StagedSequences):
+        return {
+            "S": [
+                _describe(obj.seq, path + ("seq",), encoding, leaves),
+                _describe(
+                    obj.priorities, path + ("priorities",), encoding, leaves
+                ),
+            ]
+        }
+    if isinstance(obj, SequenceBatch):
+        return {
+            "B": [
+                _describe(getattr(obj, f), path + (f,), encoding, leaves)
+                for f in ("obs", "action", "reward", "discount", "reset", "carries")
+            ]
+        }
+    if isinstance(obj, dict):
+        pairs = []
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                raise WireFormatError(
+                    f"non-string dict key {k!r} at /{'/'.join(path)}"
+                )
+            pairs.append([k, _describe(v, path + (k,), encoding, leaves)])
+        return {"d": pairs}
+    if isinstance(obj, (tuple, list)):
+        # Tuples vs lists are distinct pytree structures (LSTM carries
+        # are tuples) — preserve which one crossed the wire.
+        tag = "u" if isinstance(obj, tuple) else "l"
+        return {
+            tag: [
+                _describe(v, path + (str(i),), encoding, leaves)
+                for i, v in enumerate(obj)
+            ]
+        }
+    if isinstance(obj, (bool, np.bool_)):  # before int: bool IS an int
+        leaves.append(("t", obj, None))
+        return "t"
+    if isinstance(obj, (int, np.integer)):
+        leaves.append(("i", obj, None))
+        return "i"
+    if isinstance(obj, (float, np.floating)):
+        leaves.append(("f", obj, None))
+        return "f"
+    if isinstance(obj, np.ndarray):
+        storage = obj.dtype
+        if storage.hasobject:
+            raise WireFormatError(
+                f"object-dtype array at /{'/'.join(path)} cannot cross the wire"
+            )
+        if storage.byteorder == ">":
+            # Schema dtype names carry no byte order, so big-endian bytes
+            # would be silently reinterpreted on decode — refuse; callers
+            # normalize to native (the wire is little-endian by contract).
+            raise WireFormatError(
+                f"big-endian array at /{'/'.join(path)}: normalize to "
+                f"native byte order before the wire"
+            )
+        wire_dt = storage
+        if (
+            encoding == ENC_BF16
+            and storage == np.float32
+            and (not path or path[-1] not in F32_PINNED_LEAVES)
+        ):
+            wire_dt = _bf16_dtype()
+        leaves.append(("a", obj, wire_dt))
+        return {"a": [storage.name, wire_dt.name, list(obj.shape)]}
+    raise WireFormatError(
+        f"unsupported wire leaf type {type(obj).__name__} at /{'/'.join(path)}"
+    )
+
+
+def _leaf_part(kind: str, value: Any, wire_dt):
+    """One leaf -> one bytes-like body part (memoryview for big arrays)."""
+    if kind == "t":
+        return _I64.pack(1 if value else 0)
+    if kind == "i":
+        return _I64.pack(int(value))
+    if kind == "f":
+        return _F64.pack(float(value))
+    arr = np.ascontiguousarray(value)
+    if arr.dtype != wire_dt:
+        arr = np.ascontiguousarray(arr.astype(wire_dt))
+    if arr.nbytes >= _VIEW_MIN_BYTES:
+        # View as uint8 BEFORE taking the memoryview: custom dtypes
+        # (ml_dtypes bfloat16) have no buffer-protocol format character,
+        # so memoryview(arr) raises on them; the byte view is universal.
+        return memoryview(arr.view(np.uint8)).cast("B")
+    return arr.tobytes()
+
+
+class TreePacker:
+    """Per-connection sender state: which schema ids the peer already has.
+
+    ``always_inline=True`` is for broadcast frames (the pack-once param
+    snapshot, sent to every handler's actor including freshly reconnected
+    ones that never saw an earlier inline schema)."""
+
+    def __init__(
+        self,
+        config: WireConfig,
+        *,
+        always_inline: bool = False,
+        max_frame_bytes: int = MAX_FRAME_BYTES,
+    ):
+        self.config = config.validate()
+        self.always_inline = always_inline
+        self.max_frame_bytes = max_frame_bytes
+        # Insertion-ordered and bounded at HALF the receiver's cache cap:
+        # when the receiver FIFO-evicts a schema, the sender must have
+        # already forgotten it too (and so re-inline on the next use) —
+        # an unbounded sent-set would reference ids the peer no longer
+        # holds and kill the connection.  Half, not equal, so the sender
+        # always re-inlines strictly before the receiver could evict.
+        self._sent_ids: Dict[int, None] = {}
+        self.last_raw_len = 0
+        self.last_payload_len = 0
+
+    def pack(self, obj: Any) -> List[Any]:
+        """Payload as a list of bytes-like parts (feed to
+        ``transport.send_frame_parts`` or ``b"".join`` for storage)."""
+        leaves: List = []
+        schema = _describe(obj, (), self.config.encoding, leaves)
+        sjson = json.dumps(schema, separators=(",", ":")).encode()
+        schema_id = zlib.crc32(sjson)
+        inline = self.always_inline or schema_id not in self._sent_ids
+        body_parts = [_leaf_part(k, v, dt) for k, v, dt in leaves]
+        raw_len = sum(len(p) for p in body_parts)
+        if raw_len > self.max_frame_bytes:
+            raise FrameTooLarge(
+                f"payload body {raw_len}B exceeds frame ceiling "
+                f"{self.max_frame_bytes}B"
+            )
+        comp = self.config.compress
+        if comp != COMP_NONE and raw_len == 0:
+            # A leafless tree has no body to compress; stamping the
+            # compression code anyway would hand the receiver a "stream"
+            # it can never finish inflating — mark the frame uncompressed.
+            comp = COMP_NONE
+        if comp != COMP_NONE:
+            # Incremental compressor fed part-by-part: joining the raw
+            # body first would re-copy every tensor byte — the exact copy
+            # the zero-copy wire exists to avoid.  Output chunks stay a
+            # parts list for send_frame_parts.
+            if comp == COMP_ZLIB:
+                c = zlib.compressobj(self.config.zlib_level)
+            else:
+                c = _zstd.ZstdCompressor().compressobj()
+            compressed = []
+            for p in body_parts:
+                chunk = c.compress(p)
+                if chunk:
+                    compressed.append(chunk)
+            compressed.append(c.flush())
+            body_parts = compressed
+        head = _PAYLOAD_HEADER.pack(
+            WIRE_VERSION,
+            _COMP_CODES[comp],
+            _FLAG_SCHEMA_INLINE if inline else 0,
+            0,
+            schema_id,
+            raw_len,
+        )
+        if inline:
+            head += _SCHEMA_LEN.pack(len(sjson)) + sjson
+        parts = [head, *body_parts]
+        self._sent_ids.pop(schema_id, None)  # refresh insertion order
+        self._sent_ids[schema_id] = None
+        while len(self._sent_ids) > _SCHEMA_CACHE_MAX // 2:
+            self._sent_ids.pop(next(iter(self._sent_ids)))
+        self.last_raw_len = raw_len
+        self.last_payload_len = sum(len(p) for p in parts)
+        return parts
+
+
+# ---------------------------------------------------------------- unpack
+def _take(cursor: List[int], body, nbytes: int) -> int:
+    off = cursor[0]
+    if off + nbytes > len(body):
+        raise WireFormatError(
+            f"body overrun: leaf needs {nbytes}B at offset {off} of a "
+            f"{len(body)}B body"
+        )
+    cursor[0] = off + nbytes
+    return off
+
+
+def _rebuild(node: Any, body, cursor: List[int]) -> Any:
+    if node == "n":
+        return None
+    if node == "t":
+        return bool(_I64.unpack_from(body, _take(cursor, body, 8))[0])
+    if node == "i":
+        return int(_I64.unpack_from(body, _take(cursor, body, 8))[0])
+    if node == "f":
+        return float(_F64.unpack_from(body, _take(cursor, body, 8))[0])
+    if isinstance(node, dict) and len(node) == 1:
+        ((tag, val),) = node.items()
+        if tag == "d":
+            if not isinstance(val, list):
+                raise WireFormatError(f"malformed dict schema {val!r}")
+            out = {}
+            for entry in val:
+                if not (
+                    isinstance(entry, list)
+                    and len(entry) == 2
+                    and isinstance(entry[0], str)
+                ):
+                    raise WireFormatError(f"malformed dict entry {entry!r}")
+                out[entry[0]] = _rebuild(entry[1], body, cursor)
+            return out
+        if tag in ("u", "l") and isinstance(val, list):
+            seq = [_rebuild(c, body, cursor) for c in val]
+            return tuple(seq) if tag == "u" else seq
+        if tag == "S" and isinstance(val, list) and len(val) == 2:
+            return StagedSequences(
+                seq=_rebuild(val[0], body, cursor),
+                priorities=_rebuild(val[1], body, cursor),
+            )
+        if tag == "B" and isinstance(val, list) and len(val) == 6:
+            fields = [_rebuild(c, body, cursor) for c in val]
+            return SequenceBatch(
+                obs=fields[0],
+                action=fields[1],
+                reward=fields[2],
+                discount=fields[3],
+                reset=fields[4],
+                carries=fields[5],
+            )
+        if tag == "a" and isinstance(val, list) and len(val) == 3:
+            storage_name, wire_name, shape = val
+            if not (
+                isinstance(shape, list)
+                and all(isinstance(s, int) and s >= 0 for s in shape)
+            ):
+                raise WireFormatError(f"malformed array shape {shape!r}")
+            storage_dt = _dtype_from_name(storage_name)
+            wire_dt = _dtype_from_name(wire_name)
+            count = math.prod(shape)
+            off = _take(cursor, body, count * wire_dt.itemsize)
+            arr = np.frombuffer(
+                body, dtype=wire_dt, count=count, offset=off
+            ).reshape(shape)
+            if wire_dt != storage_dt:
+                arr = arr.astype(storage_dt)
+            return arr
+    raise WireFormatError(f"malformed schema node {node!r}")
+
+
+class TreeUnpacker:
+    """Per-connection receiver state: schema cache keyed by schema id.
+
+    A frame referencing an id this connection never saw inline is a
+    protocol error (the sender's cache and ours live and die with the
+    same socket), and errors kill the connection — transport.py's rule."""
+
+    def __init__(self, *, max_frame_bytes: int = MAX_FRAME_BYTES):
+        self.max_frame_bytes = max_frame_bytes
+        self._schemas: Dict[int, Any] = {}
+        self.last_raw_len = 0
+        self.last_payload_len = 0
+
+    def unpack(self, payload: bytes) -> Any:
+        if len(payload) < HEADER_BYTES:
+            raise WireFormatError(
+                f"payload {len(payload)}B shorter than wire header"
+            )
+        version, comp_code, flags, _rsvd, schema_id, raw_len = (
+            _PAYLOAD_HEADER.unpack_from(payload, 0)
+        )
+        if version != WIRE_VERSION:
+            raise WireFormatError(
+                f"wire version {version} != supported {WIRE_VERSION}"
+            )
+        comp = _COMP_NAMES.get(comp_code)
+        if comp is None:
+            raise WireFormatError(f"unknown compression code {comp_code}")
+        # THE zip-bomb guard: the ceiling applies to the DECLARED
+        # DECOMPRESSED size, checked before any body allocation.
+        if raw_len > self.max_frame_bytes:
+            raise FrameTooLarge(
+                f"declared decompressed payload {raw_len}B exceeds frame "
+                f"ceiling {self.max_frame_bytes}B"
+            )
+        off = HEADER_BYTES
+        if flags & _FLAG_SCHEMA_INLINE:
+            if len(payload) < off + _SCHEMA_LEN.size:
+                raise WireFormatError("truncated schema length")
+            (slen,) = _SCHEMA_LEN.unpack_from(payload, off)
+            off += _SCHEMA_LEN.size
+            if off + slen > len(payload):
+                raise WireFormatError(
+                    f"schema ({slen}B) overruns payload ({len(payload)}B)"
+                )
+            sbytes = payload[off : off + slen]
+            off += slen
+            if zlib.crc32(sbytes) != schema_id:
+                raise WireFormatError("schema bytes do not match schema id")
+            try:
+                schema = json.loads(sbytes)
+            except ValueError as e:
+                raise WireFormatError(f"unparseable schema JSON: {e}")
+            except RecursionError:
+                raise WireFormatError("schema nesting exceeds decode depth")
+            # pop-then-insert so a RE-inlined schema moves to the newest
+            # FIFO position — leaving it at its original slot would evict
+            # it while the sender (which did refresh) still references it.
+            self._schemas.pop(schema_id, None)
+            self._schemas[schema_id] = schema
+            while len(self._schemas) > _SCHEMA_CACHE_MAX:
+                # FIFO eviction (dicts iterate in insertion order): the
+                # hot schemas are re-inlined by the sender on a cache
+                # miss via the unknown-id error path killing the
+                # connection — in practice never, since real fleets use
+                # a handful of schemas.
+                self._schemas.pop(next(iter(self._schemas)))
+        else:
+            schema = self._schemas.get(schema_id)
+            if schema is None:
+                raise WireFormatError(
+                    f"unknown schema id {schema_id:#010x} (a connection's "
+                    f"first frame of each shape must inline its schema)"
+                )
+            # LRU refresh on REFERENCE, mirroring the sender's refresh on
+            # every pack: both caches see the same access sequence, so
+            # with the sender's cap at half this one's it always forgets
+            # (and re-inlines) a schema strictly before this side could
+            # evict it — FIFO here would age out a schema the sender
+            # keeps hot by id.
+            self._schemas.pop(schema_id)
+            self._schemas[schema_id] = schema
+        body = memoryview(payload)[off:]
+        if comp != COMP_NONE and raw_len == 0:
+            # The packer marks leafless frames uncompressed, so this
+            # combination is never legitimate — and it MUST be refused
+            # here: zlib's max_length=0 below would mean "no output
+            # limit", turning a declared-zero-length bomb into unbounded
+            # inflation before the length check could fire.
+            raise WireFormatError(
+                "compressed frame declaring zero decompressed length"
+            )
+        if comp == COMP_NONE:
+            if len(body) != raw_len:
+                raise WireFormatError(
+                    f"body {len(body)}B != declared raw length {raw_len}B"
+                )
+        elif comp == COMP_ZLIB:
+            d = zlib.decompressobj()
+            try:
+                # max_length=raw_len hard-caps the output allocation (the
+                # ceiling was already enforced on raw_len above); the
+                # memoryview goes in directly — no copy of the compressed
+                # body on the hot path.
+                raw = d.decompress(body, raw_len)
+            except zlib.error as e:
+                raise WireFormatError(f"zlib error: {e}")
+            if (
+                len(raw) != raw_len
+                or not d.eof
+                or d.unconsumed_tail
+                or d.unused_data  # trailing bytes AFTER the stream's end
+            ):
+                raise WireFormatError(
+                    f"declared decompressed length {raw_len}B does not "
+                    f"match the stream (got {len(raw)}B, eof={d.eof})"
+                )
+            body = memoryview(raw)
+        else:
+            if _zstd is None:
+                raise WireFormatError(
+                    "zstd-compressed frame but no zstandard module"
+                )
+            try:
+                raw = _zstd.ZstdDecompressor().decompress(
+                    body, max_output_size=raw_len
+                )
+            except _zstd.ZstdError as e:
+                # Mirror the zlib branch: codec violations must surface
+                # as FrameError so handler loops kill the CONNECTION,
+                # not their own thread.
+                raise WireFormatError(f"zstd error: {e}")
+            if len(raw) != raw_len:
+                raise WireFormatError(
+                    f"declared decompressed length {raw_len}B != {len(raw)}B"
+                )
+            body = memoryview(raw)
+        cursor = [0]
+        try:
+            obj = _rebuild(schema, body, cursor)
+        except RecursionError:
+            # A pathologically nested schema must surface as a protocol
+            # error (FrameError contract), not escape the handler's
+            # except clause and kill its thread silently.
+            raise WireFormatError("schema nesting exceeds decode depth")
+        if cursor[0] != raw_len:
+            raise WireFormatError(
+                f"schema consumed {cursor[0]}B of a {raw_len}B body"
+            )
+        self.last_raw_len = raw_len
+        self.last_payload_len = len(payload)
+        return obj

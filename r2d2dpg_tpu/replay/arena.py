@@ -28,10 +28,11 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from r2d2dpg_tpu.ops.priority import PRIORITY_EPS
 
@@ -90,6 +91,36 @@ class StagedSequences:
 
     seq: SequenceBatch  # leaves [B, L, ...] / carries [B, ...]
     priorities: Any  # [B] float32, or None (learner-computed at drain)
+
+
+def stack_staged(batches: Sequence[StagedSequences]) -> StagedSequences:
+    """Concatenate staged batches along B — the coalesced-drain payload.
+
+    Host-side (numpy): the fleet learner stacks queue-backlogged actor
+    batches BEFORE the compiled drain call so one ``add_staged`` dispatch
+    amortizes the whole backlog (fleet/ingest.py ``drain_coalesce``).  A
+    single batch passes through untouched (no copy — wire-decoded views go
+    to the device as-is); mixing resolved and unresolved priorities is a
+    caller bug (one fleet ranks one way) and refused loudly."""
+    if not batches:
+        raise ValueError("stack_staged needs at least one batch")
+    if len(batches) == 1:
+        return batches[0]
+    resolved = [b.priorities is not None for b in batches]
+    if any(resolved) != all(resolved):
+        raise ValueError(
+            "stack_staged: cannot mix resolved and unresolved priorities"
+        )
+    seq = jax.tree_util.tree_map(
+        lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
+        *[b.seq for b in batches],
+    )
+    priorities = (
+        np.concatenate([np.asarray(b.priorities) for b in batches])
+        if all(resolved)
+        else None
+    )
+    return StagedSequences(seq=seq, priorities=priorities)
 
 
 class _StagedWriterClaim:

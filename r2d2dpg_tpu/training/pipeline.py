@@ -159,6 +159,43 @@ def drain_staged(
     return LearnerState(train=train, arena=arena, rng=rng), metrics
 
 
+def bucket_width(available: int, limit: int) -> int:
+    """Power-of-two coalesce bucket: the largest 2^k <= min(available,
+    limit).
+
+    A coalesced drain's compiled program is shaped by its batch width, so
+    arbitrary widths would compile up to ``limit`` distinct programs —
+    and the bench showed those mid-run compiles eating the very dispatch
+    savings coalescing buys.  Bucketing to powers of two caps the program
+    count at log2(limit)+1 while still absorbing any backlog within a
+    factor of two of its size."""
+    n = max(1, min(available, limit))
+    return 1 << (n.bit_length() - 1)
+
+
+def coalesce_from_queue(q: "queue.Queue", first: Any, limit: int) -> list:
+    """``first`` (already blocking-got) plus queue-resident items up to
+    the power-of-two bucket of ``limit`` — never blocks, never waits for
+    stragglers.
+
+    The coalesced-drain pull schedule (fleet/ingest.py): when the learner
+    falls behind, the backlog is drained in one compiled call instead of
+    one XLA dispatch per actor batch; when it keeps up, every pull returns
+    width 1 and the schedule is byte-identical to the uncoalesced drain.
+    Widths are bucketed (``bucket_width``) so a run compiles a bounded
+    set of drain programs.  Callers whose queue carries a termination
+    sentinel must coalesce with ``limit=1`` or filter it themselves (the
+    fleet queue never does)."""
+    width = bucket_width(1 + q.qsize(), limit)
+    items = [first]
+    while len(items) < width:
+        try:
+            items.append(q.get_nowait())
+        except queue.Empty:
+            break  # qsize raced low: a rare narrower pull, never a stall
+    return items
+
+
 def split_state(state: TrainerState) -> Tuple[CollectorState, LearnerState]:
     """Partition a ``TrainerState`` into the two threads' disjoint slices.
 

@@ -20,6 +20,16 @@ SHED_SESSIONS = "shed_session_capacity"
 # Fleet ingest: the learner's staging queue is full — the actor sheds the
 # batch (collection outran learning past the queue bound) and keeps going.
 SHED_INGEST = "shed_ingest_queue_full"
+# Fleet ingest HELLO: the actor's wire version/encoding/compression does
+# not match the learner's negotiated fast lane (fleet/wire.py) — the
+# connection is refused outright; a fleet runs ONE wire format.
+REFUSED_WIRE = "refused_wire_mismatch"
 SHUTDOWN = "shutdown"
+
+# Process exit code for a REFUSED_WIRE HELLO: the one actor failure that is
+# deterministic misconfiguration, not a transient crash.  The actor exits
+# with this code and the supervisor gives the slot up instead of walking
+# the restart ladder forever (fleet/actor.py main / fleet/supervisor.py).
+EXIT_WIRE_REFUSED = 64
 
 ALL_SHED_CODES = (SHED_QUEUE, SHED_SESSIONS, SHED_INGEST)

@@ -191,6 +191,29 @@ fleet_gate() {
     *" --actors "[1-9]*) ;;
     *) return 0 ;;  # not a fleet run (or --actors 0): nothing to gate
   esac
+  # Record the NEGOTIATED wire lane in the evidence dir (ISSUE 5): a
+  # fleet number's meaning depends on what crossed the wire (bf16 and
+  # compressed lanes are different — equally valid — trajectories), so
+  # the blessing stamps which lane produced it.  Defaults mirror
+  # train.py's (--fleet-wire f32 --fleet-compress none --drain-coalesce 1).
+  local _fw_enc=f32 _fw_comp=none _fw_coal=1 _fw_prev=""
+  local _fw_arg
+  for _fw_arg in "$@"; do
+    # Both argparse spellings: "--flag value" and "--flag=value".
+    case "$_fw_arg" in
+      --fleet-wire=*) _fw_enc=${_fw_arg#*=} ;;
+      --fleet-compress=*) _fw_comp=${_fw_arg#*=} ;;
+      --drain-coalesce=*) _fw_coal=${_fw_arg#*=} ;;
+    esac
+    case "$_fw_prev" in
+      --fleet-wire) _fw_enc=$_fw_arg ;;
+      --fleet-compress) _fw_comp=$_fw_arg ;;
+      --drain-coalesce) _fw_coal=$_fw_arg ;;
+    esac
+    _fw_prev=$_fw_arg
+  done
+  printf 'encoding=%s compress=%s drain_coalesce=%s\n' \
+    "$_fw_enc" "$_fw_comp" "$_fw_coal" > "$dir/fleet_wire.txt"
   if [ -f "$dir/.fleet_determinism_ok" ]; then
     return 0
   fi

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# lint_fleet_wire.sh — no pickle on the fleet's SEQS/PARAMS steady-state
+# paths (ISSUE 5 satellite).
+#
+# The tensor hot path (SEQS experience frames, PARAMS snapshot pushes)
+# must go through the zero-copy codec in fleet/wire.py: pickle re-copies
+# every tensor byte on both ends and executes arbitrary callables on
+# load.  Control frames (HELLO/ACK/BYE — tiny trusted dicts) may keep
+# pickle via transport.pack_obj/unpack_obj, but ONLY at call sites
+# annotated `# wire-lint: control`, so every pickle crossing is an
+# audited whitelist entry, not a drift risk.
+#
+# Rules:
+#   1. The token `pickle` may appear in fleet/ only inside transport.py
+#      (the control-frame codec's single home).
+#   2. `pack_obj(` / `unpack_obj(` calls in fleet/ outside transport.py
+#      must carry the `# wire-lint: control` annotation.
+#
+# Wired into the test run via tests/test_transport.py::test_lint_fleet_wire.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+
+# Actual pickle USAGE (imports and API calls), not prose mentions in
+# comments/docstrings — the hazard is bytes crossing through pickle.
+offenders=$(grep -rn -E \
+    '(import +pickle|from +pickle|pickle\.(loads|dumps|load|dump|Pickler|Unpickler))' \
+    r2d2dpg_tpu/fleet --include='*.py' \
+    | grep -v '^r2d2dpg_tpu/fleet/transport\.py:' || true)
+if [ -n "$offenders" ]; then
+    echo "$offenders"
+    echo "lint_fleet_wire: FAIL — pickle outside fleet/transport.py;" \
+         "tensor payloads go through fleet/wire.py"
+    fail=1
+fi
+
+offenders=$(grep -rn -E '(pack_obj|unpack_obj)\(' r2d2dpg_tpu/fleet \
+    --include='*.py' \
+    | grep -v '^r2d2dpg_tpu/fleet/transport\.py:' \
+    | grep -v '# wire-lint: control' || true)
+if [ -n "$offenders" ]; then
+    echo "$offenders"
+    echo "lint_fleet_wire: FAIL — un-annotated pack_obj/unpack_obj in" \
+         "fleet/; SEQS/PARAMS must use fleet/wire.py (control frames:" \
+         "annotate the call site with '# wire-lint: control')"
+    fail=1
+fi
+
+[ "$fail" -eq 0 ] && echo "lint_fleet_wire: OK"
+exit "$fail"

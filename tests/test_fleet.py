@@ -26,7 +26,7 @@ from r2d2dpg_tpu.fleet import (
     SupervisorConfig,
     default_actor_argv,
 )
-from r2d2dpg_tpu.fleet import transport
+from r2d2dpg_tpu.fleet import transport, wire
 from r2d2dpg_tpu.fleet.transport import (
     K_ACK,
     K_HELLO,
@@ -35,6 +35,7 @@ from r2d2dpg_tpu.fleet.transport import (
     pack_obj,
     recv_frame,
     send_frame,
+    send_frame_parts,
     unpack_obj,
 )
 from r2d2dpg_tpu.obs import get_flight_recorder
@@ -126,25 +127,57 @@ def test_train_cli_refuses_fleet_combos():
             train.run(args)
 
 
+def test_train_cli_refuses_wire_flags_without_actors():
+    """The wire/drain fast-lane knobs shape the fleet data path; without
+    --actors N there is no wire — refused loudly, not silently ignored."""
+    from r2d2dpg_tpu import train
+
+    for flags in (
+        ["--fleet-wire", "bf16"],
+        ["--fleet-compress", "zlib"],
+        ["--drain-coalesce", "4"],
+    ):
+        args = train.parse_args(["--config", "pendulum_tiny", *flags])
+        with pytest.raises(SystemExit, match="require --actors"):
+            train.run(args)
+    # And an unavailable compression is refused at startup, not with a
+    # crash-looping fleet (this container has no zstandard module).
+    if "zstd" not in wire.available_compressions():
+        args = train.parse_args(
+            ["--config", "pendulum_tiny", "--actors", "1",
+             "--fleet-compress", "zstd"]
+        )
+        with pytest.raises(SystemExit, match="not available"):
+            train.run(args)
+
+
 # ------------------------------------------------------------ ingest server
 def test_ingest_server_ack_shed_and_param_push():
     q: queue.Queue = queue.Queue(maxsize=1)
-    srv = IngestServer(q, address="127.0.0.1:0", shed_after_s=0.05)
+    srv = IngestServer(
+        q, address="127.0.0.1:0", shed_after_s=0.05, startup_shed_grace_s=0.05
+    )
     srv.start()
     try:
         sock = transport.connect(srv.address)
         sock.settimeout(10)
-        send_frame(sock, K_HELLO, pack_obj({"actor_id": 3}))
+        packer = wire.TreePacker(wire.WireConfig())
+        unpacker = wire.TreeUnpacker()
+        send_frame(
+            sock,
+            K_HELLO,
+            pack_obj({"actor_id": 3, **wire.negotiation_fields(wire.WireConfig())}),
+        )
         kind, payload = recv_frame(sock)
         assert kind == K_ACK
         ack = unpack_obj(payload)
         assert ack == {"code": OK, "param_version": 0}
 
         def send_seqs(phase):
-            send_frame(
+            send_frame_parts(
                 sock,
                 K_SEQS,
-                pack_obj(
+                packer.pack(
                     {
                         "phase": phase,
                         "param_version": 0,
@@ -177,12 +210,13 @@ def test_ingest_server_ack_shed_and_param_push():
         assert srv.pop_shed_stats()["env_steps_delta"] == 12.0
         assert srv.pop_shed_stats()["env_steps_delta"] == 0.0
 
-        # A published snapshot is pushed ahead of the next ack.
+        # A published snapshot is pushed ahead of the next ack — packed in
+        # the negotiated wire format (fleet/wire.py), not pickle.
         srv.publish_params(1, {"w": np.arange(3.0)})
         send_seqs(3)
         kind, payload = recv_frame(sock)
         assert kind == K_PARAMS
-        params = unpack_obj(payload)
+        params = unpacker.unpack(payload)
         assert params["version"] == 1
         np.testing.assert_array_equal(params["params"]["w"], np.arange(3.0))
         kind, payload = recv_frame(sock)
@@ -242,12 +276,175 @@ def test_fleet_learner_drains_thread_actor():
         assert "env_steps" in scalars and "learner_steps" in scalars
 
 
+def test_ingest_stop_interrupts_startup_grace_wait():
+    """A handler parked in the startup-grace queue wait (learner still
+    compiling) must notice stop() within a slice, not hold the thread
+    for the full grace — a learner that aborts mid-compile reclaims its
+    handlers promptly."""
+    q: queue.Queue = queue.Queue(maxsize=1)
+    srv = IngestServer(
+        q, address="127.0.0.1:0", shed_after_s=60.0,
+        startup_shed_grace_s=60.0,
+    )
+    srv.start()
+    sock = transport.connect(srv.address)
+    sock.settimeout(10)
+    packer = wire.TreePacker(wire.WireConfig())
+    send_frame(
+        sock,
+        K_HELLO,
+        pack_obj({"actor_id": 0, **wire.negotiation_fields(wire.WireConfig())}),
+    )
+    recv_frame(sock)  # hello ack
+
+    def send_seqs(phase):
+        send_frame_parts(
+            sock,
+            K_SEQS,
+            packer.pack(
+                {"phase": phase, "param_version": 0, "env_steps_delta": 0.0,
+                 "ep_return_sum": 0.0, "ep_count": 0.0, "staged": _np_staged()}
+            ),
+        )
+
+    send_seqs(1)
+    recv_frame(sock)  # queued (ack): queue now full
+    send_seqs(2)  # handler parks in the graced put
+    time.sleep(0.5)
+    t0 = time.monotonic()
+    srv.stop()
+    assert time.monotonic() - t0 < 10  # not the 60 s grace
+    sock.close()
+
+
+def test_ingest_refuses_wire_mismatch():
+    """HELLO negotiation (fleet/wire.py): an actor on a different wire
+    lane is refused with REFUSED_WIRE and the connection is dropped — a
+    mismatched SEQS decode would be silent corruption, not an error."""
+    from r2d2dpg_tpu.fleet.transport import FrameTruncated
+    from r2d2dpg_tpu.utils.codes import REFUSED_WIRE
+
+    q: queue.Queue = queue.Queue(maxsize=1)
+    srv = IngestServer(
+        q,
+        address="127.0.0.1:0",
+        wire_config=wire.WireConfig(encoding="bf16"),
+    )
+    srv.start()
+    try:
+        # Wrong encoding (actor says f32, fleet runs bf16).
+        sock = transport.connect(srv.address)
+        sock.settimeout(10)
+        send_frame(
+            sock,
+            K_HELLO,
+            pack_obj(
+                {"actor_id": 0, **wire.negotiation_fields(wire.WireConfig())}
+            ),
+        )
+        kind, payload = recv_frame(sock)
+        ack = unpack_obj(payload)
+        assert kind == K_ACK and ack["code"] == REFUSED_WIRE
+        assert "encoding" in ack["reason"]
+        assert ack["expect"]["encoding"] == "bf16"
+        with pytest.raises(FrameTruncated):  # server closed the connection
+            recv_frame(sock)
+        sock.close()
+
+        # Wrong protocol version (e.g. a pre-wire actor with no fields).
+        sock = transport.connect(srv.address)
+        sock.settimeout(10)
+        send_frame(sock, K_HELLO, pack_obj({"actor_id": 1}))
+        kind, payload = recv_frame(sock)
+        ack = unpack_obj(payload)
+        assert kind == K_ACK and ack["code"] == REFUSED_WIRE
+        assert "wire_version" in ack["reason"]
+        sock.close()
+        assert q.qsize() == 0  # nothing crossed
+        assert any(
+            e["kind"] == "wire_refused"
+            for e in get_flight_recorder().events()
+        )
+    finally:
+        srv.stop()
+
+
+def test_fleet_learner_bf16_zlib_coalesced_end_to_end():
+    """The full fast lane, end-to-end minus process isolation: two thread
+    actors on the bf16+zlib wire, drain_coalesce=2 — the run completes
+    its exact phase/step schedule, the wire really compressed (declared
+    raw bytes > received bytes), and every drain width stayed within the
+    coalesce bound."""
+    from r2d2dpg_tpu.fleet.actor import FleetActor
+
+    wcfg = wire.WireConfig(encoding="bf16", compress="zlib")
+    trainer = PENDULUM_TINY.build()
+    learner = FleetLearner(
+        trainer,
+        FleetConfig(
+            num_actors=2,
+            queue_depth=4,
+            idle_timeout_s=60,
+            wire=wcfg,
+            drain_coalesce=2,
+        ),
+    )
+    address = learner.start()
+    actors = [
+        FleetActor(
+            PENDULUM_TINY,
+            actor_id=i,
+            num_actors=2,
+            address=address,
+            seed=0,
+            wire_config=wcfg,
+        )
+        for i in range(2)
+    ]
+
+    def actor_loop(a):
+        try:
+            a.run(max_phases=400)
+        except Exception:  # noqa: BLE001 — server teardown cuts the socket
+            pass
+
+    threads = [
+        threading.Thread(target=actor_loop, args=(a,), daemon=True)
+        for a in actors
+    ]
+    for t in threads:
+        t.start()
+    try:
+        state = learner.run(N_TRAIN, log_every=0)
+    finally:
+        learner.close()
+        for t in threads:
+            t.join(timeout=30)
+    tc = trainer.config
+    stats = learner.stats()
+    assert int(state.train.step) == N_TRAIN * tc.learner_steps
+    assert stats["train_phases"] == N_TRAIN
+    assert int(trainer.arena.size(state.arena)) == int(stats["absorbed_seqs"])
+    # The wire really is the compressed bf16 lane: more declared payload
+    # bytes than bytes on the wire, at under half the f32 pickle weight.
+    assert stats["wire_ratio"] > 1.0
+    assert 0 < stats["bytes_per_seq"] < 2000
+    assert 1.0 <= stats["drain_coalesce_width_mean"] <= 2.0
+
+
 def test_fleet_learner_rejections():
     trainer = PENDULUM_TINY.build()
     with pytest.raises(ValueError, match="num_actors"):
         FleetLearner(trainer, FleetConfig(num_actors=0))
     with pytest.raises(ValueError, match="queue_depth"):
         FleetLearner(trainer, FleetConfig(num_actors=1, queue_depth=0))
+    with pytest.raises(ValueError, match="drain_coalesce"):
+        FleetLearner(trainer, FleetConfig(num_actors=1, drain_coalesce=0))
+    with pytest.raises(ValueError, match="encoding"):
+        FleetLearner(
+            trainer,
+            FleetConfig(num_actors=1, wire=wire.WireConfig(encoding="f16")),
+        )
     fake = types.SimpleNamespace(axis="dp")
     with pytest.raises(ValueError, match="shard_map"):
         FleetLearner(fake, FleetConfig(num_actors=1))
@@ -402,6 +599,43 @@ def test_supervisor_gives_up_after_max_restarts():
     assert sup.restarts_total == 1
     assert any(
         e["kind"] == "actor_gave_up"
+        for e in get_flight_recorder().events()
+    )
+
+
+def test_supervisor_gives_up_immediately_on_wire_refusal():
+    """EXIT_WIRE_REFUSED is deterministic misconfiguration: the slot is
+    given up on the FIRST corpse — zero restarts, terminal flight event —
+    instead of walking the backoff ladder forever."""
+    from r2d2dpg_tpu.utils.codes import EXIT_WIRE_REFUSED
+
+    argv_fn = lambda i: [  # noqa: E731
+        sys.executable, "-c", f"exit({EXIT_WIRE_REFUSED})",
+    ]
+    sup = ActorSupervisor(
+        argv_fn,
+        1,
+        config=SupervisorConfig(backoff_base_s=0.02, poll_s=0.02),
+    )
+    sup.start()
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if any(
+                e["kind"] == "actor_gave_up"
+                and e.get("reason") == "wire_refused"
+                for e in get_flight_recorder().events()
+            ):
+                break
+            time.sleep(0.05)
+    finally:
+        sup.stop()
+    assert sup.restarts_total == 0
+    # The flight ring is global across tests: match OUR terminal event by
+    # its reason, not by position.
+    assert any(
+        e["kind"] == "actor_gave_up"
+        and e.get("reason") == "wire_refused"
         for e in get_flight_recorder().events()
     )
 

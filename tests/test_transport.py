@@ -2,11 +2,15 @@
 
 The ISSUE 4 satellite coverage: truncated frame, CRC mismatch, oversized
 payload, and the actor-side param-version regression guard (a delayed
-PARAMS frame must never roll the policy backwards).
+PARAMS frame must never roll the policy backwards).  ISSUE 5 adds the
+malformed wire-codec frame (a CRC-valid frame whose PAYLOAD violates
+fleet/wire.py), multi-part sends, and the no-pickle lint gate.
 """
 
+import os
 import socket
 import struct
+import subprocess
 
 import numpy as np
 import pytest
@@ -24,9 +28,12 @@ from r2d2dpg_tpu.fleet.transport import (
     parse_address,
     recv_frame,
     send_frame,
+    send_frame_parts,
     unpack_obj,
 )
 from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 pytestmark = pytest.mark.fleet
 
@@ -107,6 +114,105 @@ def test_oversized_payload_refused_both_sides():
     with pytest.raises(FrameTooLarge):
         recv_frame(b, max_frame_bytes=64)
     a.close(), b.close()
+
+
+def test_send_frame_parts_equivalent_to_joined_send():
+    """Multi-part zero-copy send: same bytes on the wire as a joined
+    send_frame, byte counts returned, ceiling enforced on the total."""
+    from r2d2dpg_tpu.fleet import wire
+
+    a, b = _pair()
+    staged = _staged()
+    parts = wire.TreePacker(wire.WireConfig()).pack({"staged": staged})
+    n = send_frame_parts(a, K_SEQS, parts)
+    kind, payload = recv_frame(b)
+    assert kind == K_SEQS
+    assert n == HEADER_BYTES + len(payload)
+    assert payload == b"".join(bytes(p) for p in parts)
+    got = wire.TreeUnpacker().unpack(payload)["staged"]
+    np.testing.assert_array_equal(got.seq.obs, staged.seq.obs)
+    with pytest.raises(FrameTooLarge):
+        send_frame_parts(a, K_SEQS, [b"x" * 40, b"y" * 40], max_frame_bytes=64)
+    a.close(), b.close()
+
+
+def test_malformed_wire_payload_kills_decode_not_process():
+    """A frame that passes transport framing (length + CRC fine) but whose
+    PAYLOAD violates the wire codec must surface as a FrameError subclass
+    — the connection dies, the learner does not (ISSUE 5 satellite,
+    alongside the truncated/CRC/oversize cases above)."""
+    from r2d2dpg_tpu.fleet import wire
+
+    a, b = _pair()
+    # Valid transport frame, garbage wire payload (here the junk header
+    # declares an absurd decompressed length -> the zip-bomb ceiling).
+    send_frame(a, K_SEQS, b"\x01\x00" * 10)
+    kind, payload = recv_frame(b)  # transport accepts it...
+    with pytest.raises(FrameTooLarge):  # ...the codec refuses it
+        wire.TreeUnpacker().unpack(payload)
+    # Garbage that passes the header parse dies on the schema reference.
+    send_frame(a, K_SEQS, b"\x01" + b"\x00" * 15)
+    _, payload = recv_frame(b)
+    with pytest.raises(wire.WireFormatError):
+        wire.TreeUnpacker().unpack(payload)
+    # And WireFormatError IS a FrameError: handler loops that kill the
+    # connection on FrameError cover codec violations for free.
+    assert issubclass(wire.WireFormatError, transport.FrameError)
+    a.close(), b.close()
+
+
+# ------------------------------------------------------------------ lint gate
+def test_lint_fleet_wire_clean():
+    """scripts/lint_fleet_wire.sh: no pickle on fleet SEQS/PARAMS paths
+    (annotated control-frame call sites excepted)."""
+    res = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "lint_fleet_wire.sh")],
+        capture_output=True,
+        text=True,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_lint_fleet_wire_catches_offenders(tmp_path):
+    """The gate bites: pickle usage outside transport.py fails, as does an
+    un-annotated pack_obj call."""
+    import shutil
+
+    tree = tmp_path / "repo"
+    (tree / "scripts").mkdir(parents=True)
+    shutil.copy(
+        os.path.join(REPO, "scripts", "lint_fleet_wire.sh"), tree / "scripts"
+    )
+    pkg = tree / "r2d2dpg_tpu" / "fleet"
+    pkg.mkdir(parents=True)
+    (pkg / "offender.py").write_text(
+        "import pickle\npayload = pickle.dumps({'staged': None})\n"
+    )
+    res = subprocess.run(
+        ["bash", str(tree / "scripts" / "lint_fleet_wire.sh")],
+        capture_output=True,
+        text=True,
+    )
+    assert res.returncode == 1 and "offender.py" in res.stdout
+
+    (pkg / "offender.py").write_text("x = pack_obj({'seqs': 1})\n")
+    res = subprocess.run(
+        ["bash", str(tree / "scripts" / "lint_fleet_wire.sh")],
+        capture_output=True,
+        text=True,
+    )
+    assert res.returncode == 1 and "offender.py" in res.stdout
+
+    # Annotated control-frame call sites pass.
+    (pkg / "offender.py").write_text(
+        "x = pack_obj({'code': 'ok'})  # wire-lint: control\n"
+    )
+    res = subprocess.run(
+        ["bash", str(tree / "scripts" / "lint_fleet_wire.sh")],
+        capture_output=True,
+        text=True,
+    )
+    assert res.returncode == 0, res.stdout
 
 
 def test_bad_magic_raises():

@@ -1,0 +1,593 @@
+"""Fleet wire codec (fleet/wire.py) — the ISSUE 5 tentpole coverage.
+
+Golden roundtrips per encoding (exact bytes for a fixed tree, rebuilt
+from the documented layout rather than a hex blob so a failure says WHICH
+byte moved), bf16 dtype-restoration bounds, the zip-bomb guard (ceiling
+on the DECLARED DECOMPRESSED length, before allocation), malformed-frame
+refusals, schema caching, negotiation checks, and the coalesce helpers.
+"""
+
+import json
+import queue
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.fleet import wire
+from r2d2dpg_tpu.fleet.transport import FrameTooLarge
+from r2d2dpg_tpu.fleet.wire import (
+    TreePacker,
+    TreeUnpacker,
+    WireConfig,
+    WireFormatError,
+)
+from r2d2dpg_tpu.replay.arena import (
+    SequenceBatch,
+    StagedSequences,
+    stack_staged,
+)
+from r2d2dpg_tpu.training.pipeline import bucket_width, coalesce_from_queue
+
+pytestmark = pytest.mark.fleet
+
+_HDR = struct.Struct("!BBBBIQ")
+
+
+def _bf16():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _staged(b=2, l=3, obs=4, act=2, priorities=True):
+    rng = np.random.default_rng(7)
+    return StagedSequences(
+        seq=SequenceBatch(
+            obs=rng.normal(size=(b, l, obs)).astype(np.float32),
+            action=rng.normal(size=(b, l, act)).astype(np.float32),
+            reward=rng.normal(size=(b, l)).astype(np.float32),
+            discount=np.ones((b, l), np.float32),
+            reset=np.zeros((b, l), np.float32),
+            carries={"actor": rng.normal(size=(b, 8)).astype(np.float32)},
+        ),
+        priorities=(
+            np.arange(1.0, b + 1.0, dtype=np.float32) if priorities else None
+        ),
+    )
+
+
+def _msg(staged):
+    return {
+        "phase": 9,
+        "param_version": 2,
+        "env_steps_delta": 24.0,
+        "ep_return_sum": -3.5,
+        "ep_count": 1.0,
+        "staged": staged,
+    }
+
+
+def _expected_payload(msg, encoding):
+    """The documented layout, independently rebuilt: header | schema | body
+    with leaves depth-first in field order, scalars as 8B slots, arrays as
+    raw little-endian bytes in their wire dtype."""
+    staged = msg["staged"]
+    seq = staged.seq
+
+    def wire_dt(name, arr):
+        if (
+            encoding == "bf16"
+            and arr.dtype == np.float32
+            and name not in ("reward", "discount", "priorities")
+        ):
+            return _bf16()
+        return arr.dtype
+
+    def arr_node(name, arr):
+        return {"a": [arr.dtype.name, wire_dt(name, arr).name, list(arr.shape)]}
+
+    schema = {
+        "d": [
+            ["phase", "i"],
+            ["param_version", "i"],
+            ["env_steps_delta", "f"],
+            ["ep_return_sum", "f"],
+            ["ep_count", "f"],
+            [
+                "staged",
+                {
+                    "S": [
+                        {
+                            "B": [
+                                arr_node("obs", seq.obs),
+                                arr_node("action", seq.action),
+                                arr_node("reward", seq.reward),
+                                arr_node("discount", seq.discount),
+                                arr_node("reset", seq.reset),
+                                {
+                                    "d": [
+                                        [
+                                            "actor",
+                                            arr_node(
+                                                "actor", seq.carries["actor"]
+                                            ),
+                                        ]
+                                    ]
+                                },
+                            ]
+                        },
+                        arr_node("priorities", staged.priorities),
+                    ]
+                },
+            ],
+        ]
+    }
+    sjson = json.dumps(schema, separators=(",", ":")).encode()
+    body = b"".join(
+        [
+            struct.pack("<q", msg["phase"]),
+            struct.pack("<q", msg["param_version"]),
+            struct.pack("<d", msg["env_steps_delta"]),
+            struct.pack("<d", msg["ep_return_sum"]),
+            struct.pack("<d", msg["ep_count"]),
+            *[
+                np.ascontiguousarray(a.astype(wire_dt(n, a))).tobytes()
+                for n, a in (
+                    ("obs", seq.obs),
+                    ("action", seq.action),
+                    ("reward", seq.reward),
+                    ("discount", seq.discount),
+                    ("reset", seq.reset),
+                    ("actor", seq.carries["actor"]),
+                    ("priorities", staged.priorities),
+                )
+            ],
+        ]
+    )
+    header = _HDR.pack(1, 0, 1, 0, zlib.crc32(sjson), len(body))
+    return header + struct.pack("!I", len(sjson)) + sjson + body
+
+
+@pytest.mark.parametrize("encoding", ["f32", "bf16"])
+def test_large_arrays_take_the_memoryview_path(encoding):
+    """Arrays past the zero-copy threshold ride the socket as raw byte
+    views — including bf16, whose ml_dtypes dtype has NO buffer-protocol
+    format char (a bare memoryview(arr) raises on it)."""
+    big = np.random.default_rng(0).normal(size=(64, 64)).astype(np.float32)
+    msg = {"w": big}
+    parts = TreePacker(WireConfig(encoding=encoding)).pack(msg)
+    assert any(isinstance(p, memoryview) for p in parts)
+    out = TreeUnpacker().unpack(b"".join(bytes(p) for p in parts))
+    assert out["w"].dtype == np.float32
+    if encoding == "f32":
+        np.testing.assert_array_equal(out["w"], big)
+    else:
+        np.testing.assert_allclose(out["w"], big, rtol=2**-8)
+
+
+# ------------------------------------------------------------ golden bytes
+@pytest.mark.parametrize("encoding", ["f32", "bf16"])
+def test_golden_exact_bytes_uncompressed(encoding):
+    """Pack of a fixed tree is byte-for-byte the documented layout — the
+    wire format is a contract, not an implementation detail."""
+    msg = _msg(_staged())
+    payload = b"".join(TreePacker(WireConfig(encoding=encoding)).pack(msg))
+    assert payload == _expected_payload(msg, encoding)
+
+
+@pytest.mark.parametrize("encoding", ["f32", "bf16"])
+def test_compressed_body_matches_uncompressed(encoding):
+    """zlib frames: same header semantics, the body is exactly the
+    uncompressed body's bytes through the compressor (and the roundtrip
+    restores the same tree either way)."""
+    msg = _msg(_staged())
+    plain = b"".join(
+        TreePacker(WireConfig(encoding=encoding, compress="none")).pack(msg)
+    )
+    comp = b"".join(
+        TreePacker(WireConfig(encoding=encoding, compress="zlib")).pack(msg)
+    )
+    # Locate the bodies: both frames inline the identical schema.
+    _, _, _, _, _, raw_len = _HDR.unpack_from(plain, 0)
+    (slen,) = struct.unpack_from("!I", plain, _HDR.size)
+    body_off = _HDR.size + 4 + slen
+    assert plain[:_HDR.size][4:] == comp[:_HDR.size][4:]  # schema id+len
+    assert zlib.decompress(comp[body_off:]) == plain[body_off:]
+    out = TreeUnpacker().unpack(comp)
+    ref = TreeUnpacker().unpack(plain)
+    np.testing.assert_array_equal(
+        out["staged"].seq.obs, ref["staged"].seq.obs
+    )
+    assert len(comp) < len(plain)  # the ones/zeros planes compress
+
+
+def test_zstd_gated_on_module_availability():
+    cfg = WireConfig(compress="zstd")
+    if "zstd" in wire.available_compressions():
+        cfg.validate()
+    else:
+        with pytest.raises(ValueError, match="not available"):
+            cfg.validate()
+
+
+def test_wire_config_rejects_unknown():
+    with pytest.raises(ValueError, match="encoding"):
+        WireConfig(encoding="f16").validate()
+    with pytest.raises(ValueError, match="compression"):
+        WireConfig(compress="lz4").validate()
+
+
+# --------------------------------------------------------------- fidelity
+def test_f32_wire_reproduces_payloads_exactly():
+    """The acceptance anchor: the default (f32/none) lane is bit-exact —
+    every array identical in value AND dtype, every scalar type preserved."""
+    msg = _msg(_staged())
+    out = TreeUnpacker().unpack(
+        b"".join(TreePacker(WireConfig()).pack(msg))
+    )
+    assert isinstance(out["phase"], int) and out["phase"] == 9
+    assert isinstance(out["env_steps_delta"], float)
+    got, want = out["staged"], msg["staged"]
+    for name in ("obs", "action", "reward", "discount", "reset"):
+        g, w = getattr(got.seq, name), getattr(want.seq, name)
+        assert g.dtype == w.dtype
+        np.testing.assert_array_equal(g, w)
+    np.testing.assert_array_equal(
+        got.seq.carries["actor"], want.seq.carries["actor"]
+    )
+    np.testing.assert_array_equal(got.priorities, want.priorities)
+    assert got.priorities.dtype == np.float32
+
+
+def test_bf16_restoration_dtype_and_error_bounds():
+    """bf16 lane: floats come back as float32 within bf16's 8-bit mantissa
+    (relative error <= 2^-8); pinned leaves (reward, priorities) and
+    non-f32 dtypes are untouched."""
+    msg = _msg(_staged())
+    out = TreeUnpacker().unpack(
+        b"".join(TreePacker(WireConfig(encoding="bf16")).pack(msg))
+    )
+    got, want = out["staged"], msg["staged"]
+    for name in ("obs", "action"):
+        g, w = getattr(got.seq, name), getattr(want.seq, name)
+        assert g.dtype == np.float32
+        np.testing.assert_allclose(g, w, rtol=2**-8, atol=0)
+        # And it IS quantized (the wire really was bf16, not a pass-through).
+        assert not np.array_equal(g, w)
+    np.testing.assert_array_equal(got.seq.reward, want.seq.reward)
+    np.testing.assert_array_equal(got.priorities, want.priorities)
+    # discount is PINNED f32 (dm_control emits fractional discounts that
+    # feed n-step targets); reset survives because 0/1 is bf16-exact.
+    np.testing.assert_array_equal(got.seq.discount, want.seq.discount)
+    np.testing.assert_array_equal(got.seq.reset, want.seq.reset)
+
+
+def test_leafless_tree_roundtrips_on_compressed_lane():
+    """A tree with no body bytes must still cross a zlib lane: the packer
+    marks such frames uncompressed rather than stamping a compression
+    code over a stream it never fed."""
+    packer = TreePacker(WireConfig(compress="zlib"))
+    out = TreeUnpacker().unpack(b"".join(packer.pack({"note": None})))
+    assert out == {"note": None}
+
+
+def test_schema_cache_is_bounded():
+    """An adversarial stream of endless DISTINCT inline schemas must not
+    grow the unpacker's memory without bound."""
+    u = TreeUnpacker()
+    p = TreePacker(WireConfig(), always_inline=True)
+    for i in range(wire._SCHEMA_CACHE_MAX + 16):
+        u.unpack(b"".join(p.pack({f"k{i}": float(i)})))
+    assert len(u._schemas) <= wire._SCHEMA_CACHE_MAX
+
+
+def test_sender_forgets_before_receiver_evicts():
+    """Sender/receiver cache coherence: after enough distinct schemas
+    that the receiver has FIFO-evicted early ones, a RE-send of an early
+    shape must re-inline (the sender's sent-set is bounded below the
+    receiver's cap) and still decode."""
+    p = TreePacker(WireConfig())
+    u = TreeUnpacker()
+    first = {"k0": 0.0}
+    u.unpack(b"".join(p.pack(first)))
+    for i in range(1, wire._SCHEMA_CACHE_MAX + 8):
+        u.unpack(b"".join(p.pack({f"k{i}": float(i)})))
+    # k0's schema left both caches; this pack must carry it inline again.
+    assert u.unpack(b"".join(p.pack(first))) == first
+
+
+def test_reinlined_schema_refreshes_receiver_fifo_position():
+    """A re-inlined schema must move to the NEWEST eviction slot: left at
+    its original position it would be evicted while the (refreshed)
+    sender still references it by id."""
+    p = TreePacker(WireConfig(), always_inline=True)
+    u = TreeUnpacker()
+    first = {"k0": 0.0}
+    u.unpack(b"".join(p.pack(first)))
+    for i in range(1, wire._SCHEMA_CACHE_MAX - 1):
+        u.unpack(b"".join(p.pack({f"k{i}": float(i)})))
+    u.unpack(b"".join(p.pack(first)))  # re-inline: must refresh position
+    for i in range(wire._SCHEMA_CACHE_MAX, wire._SCHEMA_CACHE_MAX + 8):
+        u.unpack(b"".join(p.pack({f"k{i}": float(i)})))
+    sjson = json.dumps(
+        {"d": [["k0", "f"]]}, separators=(",", ":")
+    ).encode()
+    assert zlib.crc32(sjson) in u._schemas  # survived the later evictions
+
+
+def test_hot_schema_survives_interleaved_churn():
+    """LRU coherence: a schema the sender keeps HOT (referenced by id
+    every other frame, never re-inlined) must survive arbitrary churn of
+    other schemas — the receiver refreshes on reference, not only on
+    inline."""
+    p = TreePacker(WireConfig())
+    u = TreeUnpacker()
+    hot = {"k0": 0.0}
+    u.unpack(b"".join(p.pack(hot)))
+    for i in range(1, wire._SCHEMA_CACHE_MAX + 8):
+        u.unpack(b"".join(p.pack({f"k{i}": float(i)})))
+        assert u.unpack(b"".join(p.pack(hot))) == hot  # stays decodable
+
+
+def test_pathological_schema_nesting_is_a_wire_error():
+    """Tens of thousands of nested list nodes must surface as
+    WireFormatError (the FrameError contract), not RecursionError."""
+    depth = 40_000
+    sjson = (b'{"l":[' * depth) + b'"n"' + (b"]}" * depth)
+    payload = (
+        _HDR.pack(1, 0, 1, 0, zlib.crc32(sjson), 0)
+        + struct.pack("!I", len(sjson))
+        + sjson
+    )
+    with pytest.raises(WireFormatError, match="depth|schema"):
+        TreeUnpacker().unpack(payload)
+
+
+def test_trailing_garbage_after_zlib_stream_refused():
+    """Bytes appended AFTER a complete compressed stream must fail the
+    declared-length contract (zlib parks them in unused_data, not
+    unconsumed_tail)."""
+    payload = b"".join(
+        TreePacker(WireConfig(compress="zlib")).pack(_msg(_staged()))
+    )
+    with pytest.raises(WireFormatError, match="declared decompressed"):
+        TreeUnpacker().unpack(payload + b"GARBAGE")
+
+
+def test_none_priorities_and_scalar_arrays_roundtrip():
+    msg = {
+        "staged": _staged(priorities=False),
+        "step": np.asarray(17, np.int32),
+        "flag": True,
+        "note": None,
+    }
+    out = TreeUnpacker().unpack(
+        b"".join(TreePacker(WireConfig(encoding="bf16")).pack(msg))
+    )
+    assert out["staged"].priorities is None
+    assert out["step"] == 17 and out["step"].dtype == np.int32
+    assert out["flag"] is True and out["note"] is None
+
+
+def test_decode_is_zero_copy_views_on_f32_wire():
+    msg = _msg(_staged())
+    payload = b"".join(TreePacker(WireConfig()).pack(msg))
+    out = TreeUnpacker().unpack(payload)
+    v = out["staged"].seq.obs
+    assert v.base is not None and not v.flags.writeable
+
+
+# ---------------------------------------------------------- schema caching
+def test_schema_cached_after_first_frame():
+    msg = _msg(_staged())
+    packer = TreePacker(WireConfig())
+    unpacker = TreeUnpacker()
+    first = b"".join(packer.pack(msg))
+    steady = b"".join(packer.pack(msg))
+    assert len(steady) < len(first)  # no inline schema on frame 2
+    out1, out2 = unpacker.unpack(first), unpacker.unpack(steady)
+    np.testing.assert_array_equal(
+        out1["staged"].seq.obs, out2["staged"].seq.obs
+    )
+    # A RECEIVER that never saw the inline schema must refuse, loudly —
+    # silent misdecode of tensor bytes would be corruption, not an error.
+    with pytest.raises(WireFormatError, match="unknown schema id"):
+        TreeUnpacker().unpack(steady)
+    # always_inline (the broadcast param snapshot): every frame standalone.
+    bcast = TreePacker(WireConfig(), always_inline=True)
+    b1, b2 = b"".join(bcast.pack(msg)), b"".join(bcast.pack(msg))
+    assert len(b1) == len(b2)
+    TreeUnpacker().unpack(b2)  # fresh receiver decodes a later frame
+
+
+# ------------------------------------------------------------ zip-bomb guard
+def test_declared_decompressed_length_ceiling_enforced_before_alloc():
+    """A tiny compressed frame declaring a huge decompressed size is
+    refused on the DECLARED length — before any allocation or inflate."""
+    sjson = b'"n"'
+    bomb = _HDR.pack(1, 1, 1, 0, zlib.crc32(sjson), 1 << 40)
+    bomb += struct.pack("!I", len(sjson)) + sjson
+    bomb += zlib.compress(b"\x00" * 1024)
+    with pytest.raises(FrameTooLarge, match="declared decompressed"):
+        TreeUnpacker(max_frame_bytes=1 << 20).unpack(bomb)
+
+
+def test_zero_declared_length_zlib_bomb_refused_without_inflation():
+    """raw_len=0 on a compressed frame must be refused OUTRIGHT: zlib's
+    max_length=0 means 'no output limit', so reaching the decompressor
+    with it would inflate a bomb unboundedly before any length check."""
+    sjson = b'"n"'
+    bomb = _HDR.pack(1, 1, 1, 0, zlib.crc32(sjson), 0)
+    bomb += struct.pack("!I", len(sjson)) + sjson
+    bomb += zlib.compress(b"\x00" * (64 << 20), 9)  # ~64 MB if inflated
+    import tracemalloc
+
+    tracemalloc.start()
+    with pytest.raises(WireFormatError, match="zero decompressed"):
+        TreeUnpacker(max_frame_bytes=1 << 20).unpack(bomb)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert peak < (8 << 20)  # never inflated the 64 MB payload
+
+
+def test_decompressed_length_lies_are_refused():
+    """Within the ceiling, the declared length must MATCH the stream: a
+    stream producing more is truncated at the cap and refused; one
+    producing less is refused too."""
+    msg = _msg(_staged())
+    payload = bytearray(
+        b"".join(TreePacker(WireConfig(compress="zlib")).pack(msg))
+    )
+    _, comp, flags, _, sid, raw_len = _HDR.unpack_from(payload, 0)
+    for lie in (raw_len - 8, raw_len + 8):
+        lying = bytearray(payload)
+        lying[:_HDR.size] = _HDR.pack(1, comp, flags, 0, sid, lie)
+        with pytest.raises((WireFormatError, FrameTooLarge)):
+            TreeUnpacker().unpack(bytes(lying))
+
+
+# ------------------------------------------------------------ malformed frames
+def test_malformed_frames_refused():
+    msg = _msg(_staged())
+    good = b"".join(TreePacker(WireConfig()).pack(msg))
+    _, _, flags, _, sid, raw_len = _HDR.unpack_from(good, 0)
+
+    # Truncated body: schema promises more leaf bytes than arrive.
+    with pytest.raises(WireFormatError, match="overrun|length"):
+        TreeUnpacker().unpack(good[:-16])
+    # Payload shorter than the wire header.
+    with pytest.raises(WireFormatError, match="shorter"):
+        TreeUnpacker().unpack(good[:8])
+    # Unknown codec version.
+    bad = bytearray(good)
+    bad[0] = 99
+    with pytest.raises(WireFormatError, match="version"):
+        TreeUnpacker().unpack(bytes(bad))
+    # Unknown compression code.
+    bad = bytearray(good)
+    bad[1] = 7
+    with pytest.raises(WireFormatError, match="compression code"):
+        TreeUnpacker().unpack(bytes(bad))
+    # Schema bytes not matching the schema id (bit-flip in the schema).
+    bad = bytearray(good)
+    bad[_HDR.size + 4 + 2] ^= 0xFF
+    with pytest.raises(WireFormatError, match="schema"):
+        TreeUnpacker().unpack(bytes(bad))
+
+
+def test_malicious_schema_refused():
+    def craft(schema_obj, body=b""):
+        sjson = json.dumps(schema_obj, separators=(",", ":")).encode()
+        return (
+            _HDR.pack(1, 0, 1, 0, zlib.crc32(sjson), len(body))
+            + struct.pack("!I", len(sjson))
+            + sjson
+            + body
+        )
+
+    # Object dtype can never cross (no pickle-style object construction).
+    with pytest.raises(WireFormatError, match="object dtype"):
+        TreeUnpacker().unpack(craft({"a": ["object", "object", [1]]}, b"x" * 8))
+    # Negative / non-int shapes.
+    with pytest.raises(WireFormatError, match="shape"):
+        TreeUnpacker().unpack(craft({"a": ["float32", "float32", [-4]]}))
+    # Nonsense node.
+    with pytest.raises(WireFormatError, match="malformed schema"):
+        TreeUnpacker().unpack(craft({"zzz": []}))
+    # Schema consuming less than the declared body is a protocol error.
+    with pytest.raises(WireFormatError, match="consumed"):
+        TreeUnpacker().unpack(craft("n", b"\x00" * 8))
+
+
+def test_malformed_dict_schema_nodes_refused():
+    """Every corrupt schema shape must surface as WireFormatError (the
+    FrameError contract), never TypeError out of the rebuild walk."""
+    def craft(schema_obj, body=b""):
+        sjson = json.dumps(schema_obj, separators=(",", ":")).encode()
+        return (
+            _HDR.pack(1, 0, 1, 0, zlib.crc32(sjson), len(body))
+            + struct.pack("!I", len(sjson))
+            + sjson
+            + body
+        )
+
+    for bad in (
+        {"d": 5},  # non-list dict payload
+        {"d": [[[], "n"]]},  # non-string key
+        {"d": [["k"]]},  # wrong entry arity
+        {"S": "nope"},  # non-list staged payload
+    ):
+        with pytest.raises(WireFormatError, match="malformed"):
+            TreeUnpacker().unpack(craft(bad))
+
+
+def test_unsupported_leaf_type_refused_at_pack():
+    with pytest.raises(WireFormatError, match="unsupported"):
+        TreePacker(WireConfig()).pack({"bad": object()})
+    # Big-endian arrays would be silently byte-swapped on decode (schema
+    # dtype names carry no byte order) — refused at pack.
+    with pytest.raises(WireFormatError, match="big-endian"):
+        TreePacker(WireConfig()).pack(
+            {"w": np.arange(4.0, dtype=np.dtype(">f4"))}
+        )
+
+
+# ------------------------------------------------------------- negotiation
+def test_negotiation_check():
+    cfg = WireConfig(encoding="bf16", compress="zlib")
+    ok = dict(wire.negotiation_fields(cfg))
+    assert wire.check_negotiation(ok, cfg) is None
+    assert "wire_version" in wire.check_negotiation({}, cfg)
+    assert "encoding" in wire.check_negotiation(
+        {**ok, "encoding": "f32"}, cfg
+    )
+    assert "compress" in wire.check_negotiation(
+        {**ok, "compress": "none"}, cfg
+    )
+
+
+# ------------------------------------------------------- coalesce helpers
+def test_stack_staged_concatenates_along_batch():
+    a, b = _staged(b=2), _staged(b=3)
+    out = stack_staged([a, b])
+    assert out.seq.obs.shape[0] == 5
+    np.testing.assert_array_equal(out.seq.obs[:2], a.seq.obs)
+    np.testing.assert_array_equal(out.seq.obs[2:], b.seq.obs)
+    np.testing.assert_array_equal(
+        out.priorities, np.concatenate([a.priorities, b.priorities])
+    )
+    # Width 1 is a pass-through (no copy of wire-decoded views).
+    assert stack_staged([a]) is a
+    # None priorities stay None; mixing is refused.
+    none_out = stack_staged(
+        [_staged(priorities=False), _staged(priorities=False)]
+    )
+    assert none_out.priorities is None
+    with pytest.raises(ValueError, match="mix"):
+        stack_staged([a, _staged(priorities=False)])
+    with pytest.raises(ValueError, match="at least one"):
+        stack_staged([])
+
+
+def test_bucket_width_powers_of_two():
+    assert [bucket_width(n, 4) for n in range(1, 8)] == [1, 2, 2, 4, 4, 4, 4]
+    assert bucket_width(100, 8) == 8
+    assert bucket_width(0, 4) == 1  # degenerate: never below one
+    assert bucket_width(3, 1) == 1
+
+
+def test_coalesce_from_queue_takes_only_whats_there():
+    q: queue.Queue = queue.Queue()
+    for i in range(2):
+        q.put(i + 1)
+    # first + both queued = 3 available -> power-of-two bucket 2.
+    assert coalesce_from_queue(q, 0, 10) == [0, 1]
+    assert coalesce_from_queue(q, 5, 10) == [5, 2]  # 2 avail -> bucket 2
+    assert coalesce_from_queue(q, 5, 10) == [5]  # empty queue: width 1
+    for i in range(7, 11):
+        q.put(i)
+    assert coalesce_from_queue(q, 6, 4) == [6, 7, 8, 9]  # limit bucket 4
+    assert coalesce_from_queue(q, 6, 2) == [6, 10]  # limit respected
+    assert q.empty()
