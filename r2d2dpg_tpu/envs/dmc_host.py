@@ -48,7 +48,7 @@ import numpy as np
 from jax.experimental import io_callback
 
 from r2d2dpg_tpu.envs.core import EnvSpec, TimeStep
-from r2d2dpg_tpu.envs.native_pool import _pool_instruments
+from r2d2dpg_tpu.envs.native_pool import PoolObsMixin
 
 _PIXEL_HW = 64
 
@@ -64,7 +64,7 @@ def _flatten_obs(obs_dict) -> np.ndarray:
     return np.concatenate(parts) if parts else np.zeros((0,), np.float32)
 
 
-class _HostPool:
+class _HostPool(PoolObsMixin):
     """The host-side fleet: E dm_control envs + a thread pool."""
 
     # Render thread-pool width.  Each env is PINNED to one render thread
@@ -89,9 +89,7 @@ class _HostPool:
         # thread the collect program's ordered callback runs on) while other
         # code may still reach it — serialize whole-fleet transitions.
         self._step_lock = threading.Lock()
-        self._obs_step, self._obs_lock_wait, self._obs_resets = (
-            _pool_instruments("python")
-        )
+        self._init_pool_obs()  # lazy role-labelled instruments (PoolObsMixin)
 
     def ensure(self, seeds: np.ndarray):
         """Create or re-seed the fleet to match the per-env ``seeds``."""
@@ -189,6 +187,8 @@ class _HostPool:
         if repeat < 1:
             raise ValueError(f"repeat must be >= 1, got {repeat}")
         t_lock = time.monotonic()
+        if self._obs_step is None:
+            self._bind_pool_obs()
         with self._step_lock:
             t0 = time.monotonic()
             self._obs_lock_wait.add(t0 - t_lock)
@@ -315,6 +315,10 @@ class DMCHostEnv:
         else:
             self._pool = _HostPool(domain, task, pixels, camera_id)
         self.native = use_native
+
+    def set_role(self, role: str) -> None:
+        """Label this env's pool metrics by purpose (train|eval|actor)."""
+        self._pool.set_role(role)
 
     # ------------------------------------------------------------- callbacks
     def _result_shapes(self, e: int):

@@ -28,29 +28,65 @@ import numpy as np
 from r2d2dpg_tpu.obs import get_registry
 
 
-def _pool_instruments(pool: str):
-    """The shared env-pool instrument set, bound to one ``pool`` label.
+def _pool_instruments(pool: str, role: str = "train"):
+    """The shared env-pool instrument set, bound to one label set.
 
     One metric family each for step latency, lock waits and resets —
     ``pool="native"`` (C++ fleet) vs ``pool="python"`` (dm_control fleet)
-    distinguishes the implementations at scrape time."""
+    distinguishes the implementations; ``role="train"|"eval"|"actor"``
+    distinguishes the *instances* (the training fleet, the evaluator's
+    separate fleet, a fleet actor's pool), so concurrent pools of the same
+    kind no longer interleave into one cell at scrape time."""
     reg = get_registry()
     step = reg.histogram(
         "r2d2dpg_envpool_step_seconds",
         "whole-fleet batched env step latency",
-        labelnames=("pool",),
-    ).labels(pool=pool)
+        labelnames=("pool", "role"),
+    ).labels(pool=pool, role=role)
     lock = reg.histogram(
         "r2d2dpg_envpool_lock_wait_seconds",
         "wait to acquire the fleet step lock (cross-thread contention)",
-        labelnames=("pool",),
-    ).labels(pool=pool)
+        labelnames=("pool", "role"),
+    ).labels(pool=pool, role=role)
     resets = reg.counter(
         "r2d2dpg_envpool_resets_total",
         "episode auto-resets across the fleet",
-        labelnames=("pool",),
-    ).labels(pool=pool)
+        labelnames=("pool", "role"),
+    ).labels(pool=pool, role=role)
     return step, lock, resets
+
+
+class PoolObsMixin:
+    """Role-labelled, lazily-bound pool instruments — shared by
+    ``NativeEnvPool`` and ``dmc_host._HostPool`` so the two never diverge.
+
+    Instruments bind LAZILY on the first step: the role is set by whoever
+    knows the instance's purpose (the evaluator, a fleet actor) AFTER the
+    shared factory constructs the pool, and an eager __init__ bind would
+    register a phantom zero-count ``role="train"`` cell that every scrape
+    (and TELEM snapshot) carries forever."""
+
+    _POOL_KIND = "python"  # subclass overrides: "native" | "python"
+
+    def _init_pool_obs(self) -> None:
+        self._role = "train"
+        self._obs_step = self._obs_lock_wait = self._obs_resets = None
+
+    def set_role(self, role: str) -> None:
+        """Name this pool's metric role (train|eval|actor) so concurrent
+        pools stop interleaving into one cell (the evaluator's pool vs the
+        training pool — docs/OBSERVABILITY.md); called by whoever knows
+        the instance's purpose right after construction, re-binding in
+        place if the pool already stepped under another role."""
+        self._role = role
+        if self._obs_step is not None:
+            self._bind_pool_obs()
+
+    def _bind_pool_obs(self) -> None:
+        self._obs_step, self._obs_lock_wait, self._obs_resets = (
+            _pool_instruments(self._POOL_KIND, self._role)
+        )
+
 
 # (domain, task) -> TaskId in native/envpool/env_pool.cc.
 NATIVE_TASKS = {
@@ -161,13 +197,15 @@ def _dptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
 
 
-class NativeEnvPool:
+class NativeEnvPool(PoolObsMixin):
     """Drop-in replacement for the Python ``_HostPool`` (state obs only).
 
     Same batched contract: ``reset_all(seeds)`` / ``step_all(actions)``
     return ``(obs, reward, discount, reset)`` float32 arrays; episode ends
     auto-reset with the fresh obs flagged ``reset=1``.
     """
+
+    _POOL_KIND = "native"
 
     def __init__(self, domain: str, task: str, num_threads: int = 0):
         if (domain, task) not in NATIVE_TASKS:
@@ -182,9 +220,7 @@ class NativeEnvPool:
         # mjData in place, and the pipelined executor steps it from a
         # collector thread — whole-fleet transitions are serialized.
         self._step_lock = threading.Lock()
-        self._obs_step, self._obs_lock_wait, self._obs_resets = (
-            _pool_instruments("native")
-        )
+        self._init_pool_obs()  # lazy role-labelled instruments (PoolObsMixin)
 
     # ------------------------------------------------------------- lifecycle
     def _create(self, seeds: np.ndarray) -> None:
@@ -249,6 +285,8 @@ class NativeEnvPool:
         if repeat < 1:
             raise ValueError(f"repeat must be >= 1, got {repeat}")
         t_lock = time.monotonic()
+        if self._obs_step is None:
+            self._bind_pool_obs()
         with self._step_lock:
             t0 = time.monotonic()
             self._obs_lock_wait.add(t0 - t_lock)

@@ -28,7 +28,9 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import socket as socket_mod
 import sys
+import time
 from typing import Any, Optional
 
 import jax
@@ -44,6 +46,7 @@ from r2d2dpg_tpu.fleet.transport import (
     K_HELLO,
     K_PARAMS,
     K_SEQS,
+    K_TELEM,
     FrameError,
     connect,
     pack_obj,
@@ -53,6 +56,7 @@ from r2d2dpg_tpu.fleet.transport import (
     unpack_obj,
 )
 from r2d2dpg_tpu.obs import flight_event, get_registry, set_flight_identity
+from r2d2dpg_tpu.obs import trace as obs_trace
 from r2d2dpg_tpu.ops import sigma_ladder
 from r2d2dpg_tpu.replay.arena import StagedSequences
 from r2d2dpg_tpu.training.assembler import emit
@@ -131,9 +135,17 @@ class FleetActor:
         seed: Optional[int] = None,
         wire_config: Optional[wire.WireConfig] = None,
         max_frame_bytes: int = MAX_FRAME_BYTES,
+        telem_every: float = 0.0,
+        trace_sample: float = 0.0,
     ):
         self.actor_id = actor_id
         self.address = address
+        # Fleet observability plane (ISSUE 6): TELEM snapshot cadence in
+        # seconds (0 = off; train.py --obs-fleet spawns actors at 1 Hz)
+        # and the experience-path trace sampling rate (0 = off).
+        self.telem_every = float(telem_every)
+        self.trace_sample = float(trace_sample)
+        self._telem_last = 0.0
         # The wire fast lane (fleet/wire.py): must MIRROR the learner's
         # --fleet-wire/--fleet-compress — the ingest server refuses a
         # mismatched HELLO (one fleet, one wire format).
@@ -147,6 +159,10 @@ class FleetActor:
             exp, actor_index=actor_id, num_actors=num_actors
         )
         t = self.trainer
+        # Host-pool envs label their r2d2dpg_envpool_* series per ROLE so a
+        # fleet's actor pools never interleave with a learner-side pool.
+        if hasattr(t.env, "set_role"):
+            t.env.set_role("actor")
         seed = t.config.seed if seed is None else seed
         # Distinct stream per actor: same base seed, folded actor index —
         # a fleet at seed S is a different (equally valid) trajectory per
@@ -190,6 +206,10 @@ class FleetActor:
         self._obs_bytes_in = reg.counter(
             "r2d2dpg_actor_bytes_in_total",
             "bytes this actor received off the fleet wire (acks + params)",
+        )
+        self._obs_telem = reg.counter(
+            "r2d2dpg_actor_telem_sent_total",
+            "TELEM registry snapshots pushed to the learner's ingest",
         )
 
     # ---------------------------------------------------------- device parts
@@ -298,10 +318,21 @@ class FleetActor:
                     f"the learner's --fleet-wire/--fleet-compress "
                     f"(server expects {hello_ack.get('expect')})"
                 )
+            self._maybe_send_telem(sock, force=True)
             while max_phases is None or self._phase < max_phases:
+                # Trace sampling decided at collection time (obs/trace.py):
+                # rate 0 allocates nothing and the frame is byte-identical
+                # to an untraced wire.
+                tr = obs_trace.maybe_start(self.trace_sample)
                 staged = self.collect_phase()
                 if staged is None:
-                    continue  # warm-up: window not yet real
+                    # Warm-up: window not yet real.  The TELEM cadence must
+                    # still tick — warm-up phases (the first carries the
+                    # JIT compile, tens of seconds) would otherwise read as
+                    # a wedged actor on the staleness gauge after every
+                    # supervised restart.
+                    self._maybe_send_telem(sock)
+                    continue
                 # ONE batched device fetch per phase (episode stats + the
                 # staged pytree + priorities) — the pop_episode_metrics
                 # lesson; separate fetches would be three host syncs on
@@ -316,6 +347,10 @@ class FleetActor:
                         )
                     )
                 )
+                if tr is not None:
+                    # Collection "ends" when the host holds the batch: the
+                    # fetch above is part of the collect hop.
+                    tr.t_collect_end = time.time()
                 # DELTAS, not cumulative: a supervised restart resets this
                 # process, and the learner's fleet-wide sums must stay
                 # monotone across incarnations (ingest just accumulates).
@@ -334,7 +369,8 @@ class FleetActor:
                         "staged": StagedSequences(
                             seq=seq_host, priorities=prios_host
                         ),
-                    }
+                    },
+                    trace=tr,
                 )
                 self._obs_bytes_out.inc(
                     send_frame_parts(
@@ -348,6 +384,7 @@ class FleetActor:
                 if ack["code"] == SHED_INGEST:
                     self._sheds += 1
                     self._obs_shed.inc()
+                self._maybe_send_telem(sock)
             try:
                 send_frame(sock, K_BYE, b"")  # wire-lint: control
             except OSError:
@@ -357,6 +394,36 @@ class FleetActor:
                 sock.close()
             except OSError:
                 pass
+
+    def _maybe_send_telem(self, sock, force: bool = False) -> None:
+        """The ~1 Hz TELEM cadence rider (ISSUE 6 leg 1): push this
+        process's registry snapshot so the learner's exporter is the
+        fleet's single scrape point.  Fire-and-forget control frame — no
+        ack (the next SEQS ack already paces the connection); rides the
+        collect loop, so a wedged actor's silence is itself the signal
+        (the ingest side's per-actor staleness gauge keeps counting)."""
+        if self.telem_every <= 0.0:
+            return
+        now = time.monotonic()
+        if not force and now - self._telem_last < self.telem_every:
+            return
+        self._telem_last = now
+        self._obs_telem.inc()
+        self._obs_bytes_out.inc(
+            send_frame(
+                sock,
+                K_TELEM,
+                pack_obj(  # wire-lint: control
+                    {
+                        "actor_id": self.actor_id,
+                        "host": socket_mod.gethostname(),
+                        "t_wall": time.time(),
+                        "snapshot": get_registry().snapshot(),
+                    }
+                ),
+                max_frame_bytes=self.max_frame_bytes,
+            )
+        )
 
     def _await_ack(self, sock) -> Any:
         """Read to the next ACK, applying any PARAMS pushed ahead of it
@@ -442,6 +509,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                    "FleetConfig.max_frame_bytes (the spawner forwards it)")
     p.add_argument("--flight-path", default=None,
                    help="dump this actor's flight ring here on exit")
+    # Fleet observability plane (ISSUE 6; train.py --obs-fleet/
+    # --trace-sample forward these).
+    p.add_argument("--telem-every", type=float, default=0.0,
+                   help="seconds between TELEM registry-snapshot pushes to "
+                   "the learner's ingest (0 = off; --obs-fleet spawns 1.0)")
+    p.add_argument("--trace-sample", type=float, default=0.0,
+                   help="experience-path trace sampling rate in [0, 1] "
+                   "(0 = off: no trace sidecar, byte-identical wire)")
     return p.parse_args(argv)
 
 
@@ -473,9 +548,17 @@ def main(argv=None) -> None:
     args = parse_args(argv)
     set_flight_identity(actor=args.actor_id)
     if args.flight_path:
+        import signal
+
         from r2d2dpg_tpu.obs import get_flight_recorder
 
         get_flight_recorder().install(args.flight_path)
+        # The supervisor's orderly teardown is a SIGTERM, whose default
+        # disposition skips atexit — and with it the flight dump this
+        # flag just armed.  Convert it to a clean SystemExit so every
+        # incarnation leaves its flight_actor<i>.jsonl for the fleet
+        # timeline merge (obs/flight.py).
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
     exp = _apply_overrides(get_config(args.config), args)
     try:
         wire_config = wire.WireConfig(
@@ -483,6 +566,10 @@ def main(argv=None) -> None:
         ).validate()
     except ValueError as e:
         raise SystemExit(f"fleet actor {args.actor_id}: --compress: {e}")
+    if not 0.0 <= args.trace_sample <= 1.0:
+        raise SystemExit(
+            f"fleet actor {args.actor_id}: --trace-sample must be in [0, 1]"
+        )
     actor = FleetActor(
         exp,
         actor_id=args.actor_id,
@@ -491,6 +578,8 @@ def main(argv=None) -> None:
         seed=args.seed,
         wire_config=wire_config,
         max_frame_bytes=args.max_frame_bytes,
+        telem_every=args.telem_every,
+        trace_sample=args.trace_sample,
     )
     flight_event("actor_start", phase=0, address=args.connect)
     try:

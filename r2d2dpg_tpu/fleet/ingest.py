@@ -19,6 +19,11 @@ connection's ONLY writer, so acks and param pushes never interleave):
     SEQS {staged, stats}      ->   staging_queue.put (bounded wait)
                               <-   [PARAMS]     (actor's version is stale)
                               <-   ACK {code: ok | shed_ingest_queue_full}
+    TELEM {snapshot}          ->   fold into the obs RemoteMirror under
+                                   actor=/host= labels (no ack; malformed
+                                   frames drop with a flight event) — the
+                                   learner's /metrics is the fleet's ONE
+                                   scrape point (ISSUE 6)
     ...
     BYE                       ->   (or either side just closes)
 
@@ -54,6 +59,7 @@ from r2d2dpg_tpu.fleet.transport import (
     K_HELLO,
     K_PARAMS,
     K_SEQS,
+    K_TELEM,
     FrameError,
     pack_obj,
     recv_frame,
@@ -61,8 +67,9 @@ from r2d2dpg_tpu.fleet.transport import (
     to_host,
     unpack_obj,
 )
-from r2d2dpg_tpu.obs import flight_event, get_registry
-from r2d2dpg_tpu.replay.arena import stack_staged
+from r2d2dpg_tpu.obs import flight_event, get_registry, get_remote_mirror
+from r2d2dpg_tpu.obs import trace as obs_trace
+from r2d2dpg_tpu.replay.arena import stack_staged, staged_nbytes
 from r2d2dpg_tpu.training.pipeline import (
     LearnerState,
     coalesce_from_queue,
@@ -208,6 +215,23 @@ class IngestServer:
             "declared decompressed size over received payload size of the "
             "last SEQS frame (1.0 = uncompressed wire)",
         )
+        # Fleet observability plane (ISSUE 6 leg 1): TELEM snapshots fold
+        # into the process RemoteMirror (the exporter merges it into ONE
+        # /metrics page), and each actor gets a live staleness gauge so a
+        # wedged actor reads as STALE, never as silently frozen series.
+        self._mirror = get_remote_mirror()
+        self._telem_last: Dict[str, float] = {}
+        self._obs_telem = reg.counter(
+            "r2d2dpg_fleet_telem_frames_total",
+            "TELEM registry snapshots received from actors",
+            labelnames=("actor",),
+        )
+        self._obs_telem_staleness = reg.gauge(
+            "r2d2dpg_fleet_telem_staleness_seconds",
+            "seconds since this actor's last TELEM snapshot (a wedged or "
+            "dead actor goes visibly stale)",
+            labelnames=("actor",),
+        )
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "IngestServer":
@@ -336,6 +360,48 @@ class IngestServer:
                 # else a newer publish raced in: later pushes pack the new
                 # version; THIS push still sends the frame it packed.
         return version, frame
+
+    def _fold_telem(self, actor: str, telem: Any) -> None:
+        """Fold one actor's TELEM snapshot into the remote mirror under
+        ``actor=<id>`` (+ ``host=``) labels.
+
+        Keyed by actor id, so a reconnecting (supervised-restarted) actor
+        UPDATES its slot — label re-registration is idempotent and the
+        scrape never grows duplicate sources.  The actor id comes from the
+        connection's HELLO, never from the TELEM payload: a confused frame
+        cannot relabel another actor's series.  Raises on malformed
+        payloads (the handler drops them with a flight event)."""
+        if not isinstance(telem, dict):
+            raise ValueError("TELEM payload is not a dict")
+        snapshot = telem.get("snapshot")
+        if not isinstance(snapshot, dict):
+            raise ValueError("TELEM snapshot is not a dict")
+        labels = {"actor": actor}
+        host = telem.get("host")
+        if host:
+            labels["host"] = str(host)
+        self._mirror.update(f"actor:{actor}", labels, snapshot)
+        with self._lock:
+            self._telem_last[actor] = time.monotonic()
+        self._arm_telem_staleness(actor)
+        self._obs_telem.labels(actor=actor).inc()
+
+    def _arm_telem_staleness(self, actor: str) -> None:
+        """Install the actor's live staleness gauge (idempotent).
+
+        Armed at HELLO — counting from connection time — so an actor that
+        connects but never delivers a well-formed TELEM still shows a
+        GROWING staleness series instead of being silently absent (the
+        exact failure the staleness design exists to surface); each fold
+        re-arms it, which just overwrites the same closure.  The
+        ``.get(a, 0.0)`` default is the sentinel a fold always overwrites,
+        so the closure never KeyErrors even if an operator clears state
+        mid-scrape."""
+        with self._lock:
+            self._telem_last.setdefault(actor, time.monotonic())
+        self._obs_telem_staleness.labels(actor=actor).set_fn(
+            lambda a=actor: time.monotonic() - self._telem_last.get(a, 0.0)
+        )
 
     def pop_shed_stats(self) -> Dict[str, float]:
         """Drain the scalar stats banked off shed messages (learner-side,
@@ -474,6 +540,9 @@ class IngestServer:
                     )
                 )
                 return
+            # Accepted actor: staleness is visible from THIS moment, not
+            # from its first well-formed TELEM (which may never come).
+            self._arm_telem_staleness(actor)
             sent_version = self._push_params_if_stale(conn, 0, bytes_out)
             bytes_out.inc(
                 send_frame(
@@ -488,12 +557,48 @@ class IngestServer:
                 kind, payload = recv_frame(
                     conn, max_frame_bytes=self.max_frame_bytes
                 )
+                t_recv = time.time()
                 bytes_in.inc(HEADER_BYTES + len(payload))
                 if kind == K_BYE:
                     return
+                if kind == K_TELEM:
+                    # Fire-and-forget metric aggregation: fold or drop —
+                    # a malformed snapshot must cost ONE flight event, not
+                    # the connection (the experience path is unaffected).
+                    try:
+                        self._fold_telem(
+                            actor, unpack_obj(payload)  # wire-lint: control
+                        )
+                    except Exception as e:  # noqa: BLE001 - quarantine
+                        flight_event(
+                            "telem_malformed",
+                            actor=actor,
+                            error=f"{type(e).__name__}: {e}",
+                        )
+                    continue
                 if kind != K_SEQS:
                     raise FrameError(f"expected SEQS/BYE, got kind {kind}")
                 msg = unpacker.unpack(payload)
+                t_decode_end = time.time()
+                tr = unpacker.last_trace
+                if tr is not None:
+                    # The sampled batch's actor-side hops (off the wire
+                    # sidecar) + this handler's transit/decode timestamps
+                    # ride the queue message; NOTHING is recorded here.
+                    # The drain loop records all 8 hops together for the
+                    # batches it actually traces through learn, so every
+                    # hop histogram shares ONE sample population — an
+                    # absorb-phase or shed batch contributes no partial
+                    # 4-hop chain ("absorb batches are untraced").
+                    msg["trace"] = {
+                        "id": tr.trace_id,
+                        "actor": actor,
+                        "t_collect_start": tr.t_collect_start,
+                        "t_collect_end": tr.t_collect_end,
+                        "t_encode_end": tr.t_encode_end,
+                        "t_recv": t_recv,
+                        "t_enqueue_start": t_decode_end,
+                    }
                 msg["actor_id"] = actor
                 n_seqs = int(
                     np.shape(msg["staged"].seq.reward)[0]
@@ -723,6 +828,7 @@ class FleetLearner:
                     continue
                 self.learner_wait.add(time.monotonic() - t_wait)
                 last_batch_t = time.monotonic()
+                t_dequeue = time.time()
                 # Coalesced drain (drain_coalesce): the blocking-got batch
                 # plus whatever backlog the queue ALREADY holds, stacked
                 # into ONE compiled call — the arena-add dispatch is paid
@@ -744,6 +850,12 @@ class FleetLearner:
                 ep_ret_sum += shed_stats["ep_return_sum"]
                 ep_count += shed_stats["ep_count"]
                 staged = stack_staged([m["staged"] for m in msgs])
+                t_stack_end = time.time()
+                # Sampled batches' hops (obs/trace.py): absorb phases are
+                # untraced (their "learn" would be a lie), so ALL 8 hops —
+                # including the actor-side stamps riding the message — are
+                # recorded only once the run is draining for real.
+                traces = [m["trace"] for m in msgs if m.get("trace")]
                 n_seqs = int(np.shape(staged.seq.reward)[0])
                 for msg in msgs:
                     ep_ret_sum += float(msg.get("ep_return_sum", 0.0))
@@ -760,6 +872,48 @@ class FleetLearner:
                     continue
                 with t.arena.staged_writer():
                     lstate, last_metrics = self._drain_prog(lstate, staged)
+                t_dispatch_end = time.time()
+                if traces:
+                    # One block_until_ready per SAMPLED drain is what makes
+                    # the learn hop honest (async dispatch otherwise
+                    # returns immediately); unsampled drains pay nothing.
+                    jax.block_until_ready(lstate.train.step)
+                    t_done = time.time()
+                    nbytes = staged_nbytes(staged)
+                    for tr in traces:
+                        tid, act = tr["id"], tr.get("actor")
+                        obs_trace.record_hop(
+                            "collect", tr["t_collect_start"],
+                            tr["t_collect_end"], tid, actor=act,
+                        )
+                        obs_trace.record_hop(
+                            "encode", tr["t_collect_end"],
+                            tr["t_encode_end"], tid, actor=act,
+                        )
+                        obs_trace.record_hop(
+                            "transit", tr["t_encode_end"], tr["t_recv"],
+                            tid, actor=act,
+                        )
+                        obs_trace.record_hop(
+                            "decode", tr["t_recv"], tr["t_enqueue_start"],
+                            tid, actor=act,
+                        )
+                        obs_trace.record_hop(
+                            "enqueue", tr["t_enqueue_start"], t_dequeue,
+                            tid, actor=act,
+                        )
+                        obs_trace.record_hop(
+                            "coalesce", t_dequeue, t_stack_end,
+                            tid, actor=act, width=len(msgs),
+                        )
+                        obs_trace.record_hop(
+                            "arena_add", t_stack_end, t_dispatch_end,
+                            tid, actor=act, bytes=nbytes, seqs=n_seqs,
+                        )
+                        obs_trace.record_hop(
+                            "learn", t_dispatch_end, t_done,
+                            tid, actor=act,
+                        )
                 drained += 1
                 if train_t0 is None:
                     # The first drain carries the compile; the sustained

@@ -27,7 +27,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from r2d2dpg_tpu.obs import flight_event
+from r2d2dpg_tpu.obs import flight_event, get_registry
 from r2d2dpg_tpu.utils.codes import EXIT_WIRE_REFUSED
 
 
@@ -86,6 +86,19 @@ class ActorSupervisor:
         self._lock = threading.Lock()
         self._stopping = threading.Event()
         self._monitor: Optional[threading.Thread] = None
+        # Fleet health at scrape time (ISSUE 6): the central actor-health
+        # view Ape-X-scale fleets live on — live process count (set_fn:
+        # evaluated per scrape) and cumulative restarts.
+        reg = get_registry()
+        self._obs_alive = reg.gauge(
+            "r2d2dpg_fleet_actors_alive",
+            "live supervised actor subprocesses",
+        )
+        self._obs_alive.set_fn(lambda: float(self.alive_count()))
+        self._obs_restarts = reg.counter(
+            "r2d2dpg_fleet_actor_restarts_total",
+            "supervised actor restarts (crash -> backoff -> respawn)",
+        )
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "ActorSupervisor":
@@ -239,6 +252,7 @@ class ActorSupervisor:
                             slot.restart_at = now + cfg.backoff_max_s
                             continue
                         slot.restarts += 1
+                        self._obs_restarts.inc()
                         flight_event(
                             "actor_restart",
                             actor=actor_id,

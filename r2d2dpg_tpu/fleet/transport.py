@@ -18,9 +18,9 @@ byte stream — localhost TCP (``"host:port"``) or a Unix domain socket
 - **EOF mid-frame** raises ``FrameTruncated`` — a half-written frame from a
   crashed actor never silently becomes a short payload.
 
-Payload encoding is per frame KIND: control frames (HELLO/ACK/BYE) carry
-small pickled dicts (``pack_obj``/``unpack_obj`` — annotated call sites
-only; ``scripts/lint_fleet_wire.sh`` enforces the whitelist), while the
+Payload encoding is per frame KIND: control frames (HELLO/ACK/BYE/TELEM)
+carry small pickled dicts (``pack_obj``/``unpack_obj`` — annotated call
+sites only; ``scripts/lint_fleet_wire.sh`` enforces the whitelist), while the
 steady-state tensor frames (SEQS/PARAMS) carry the zero-copy binary
 format of ``fleet/wire.py`` — schema-cached headers plus raw contiguous
 tensor bytes, sent without intermediate copies via ``send_frame_parts``.
@@ -57,6 +57,7 @@ K_SEQS = 2  # actor -> ingest: one staged experience batch + actor stats
 K_ACK = 3  # ingest -> actor: {"code": OK|SHED_INGEST, "param_version": v}
 K_PARAMS = 4  # ingest -> actor: {"version": v, "params": {...numpy trees}}
 K_BYE = 5  # either side: orderly goodbye
+K_TELEM = 6  # actor -> ingest: registry-scalar snapshot (~1 Hz, no ack)
 
 # 256 MiB default ceiling: a humanoid-shaped staged batch (256 envs x seq
 # 85) is ~20 MiB, so this bounds corruption blast radius without touching

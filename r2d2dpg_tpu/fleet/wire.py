@@ -9,11 +9,16 @@ format:
 
 ::
 
-    payload := wire_header | [schema] | body
+    payload := wire_header | [trace] | [schema] | body
     wire_header (16B, "!BBBBIQ"):
         version (1B) | compress (1B) | flags (1B) | reserved (1B)
         schema_id (u32 = crc32 of the schema JSON)
         raw_len   (u64 = DECOMPRESSED body length)
+    trace (present iff flags bit 1, 32B "!Qddd"): a SAMPLED batch's trace
+        id + actor-side hop timestamps (collect start/end, encode end) —
+        the experience-path tracing sidecar (obs/trace.py).  Unsampled
+        frames carry nothing: tracing at rate 0 is byte-identical to a
+        wire without it.
     schema (present iff flags bit 0): u32 length + compact JSON describing
         tree structure + per-leaf dtypes/shapes.  Scalars (phase counters,
         episode deltas) live in the BODY (8B each), so the schema is
@@ -57,6 +62,7 @@ import dataclasses
 import json
 import math
 import struct
+import time
 import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -67,6 +73,7 @@ from r2d2dpg_tpu.fleet.transport import (
     FrameError,
     FrameTooLarge,
 )
+from r2d2dpg_tpu.obs.trace import TraceStamp
 from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
 
 WIRE_VERSION = 1
@@ -111,6 +118,14 @@ _SCHEMA_LEN = struct.Struct("!I")
 _F64 = struct.Struct("<d")
 _I64 = struct.Struct("<q")
 _FLAG_SCHEMA_INLINE = 1
+# Trace sidecar (ISSUE 6): a SAMPLED frame carries a fixed 32-byte stamp —
+# trace id + the actor-side hop timestamps (collect start/end, encode end)
+# — right after the wire header, BEFORE any inline schema.  A sidecar
+# instead of schema fields keeps the schema byte-stable (same crc32 id
+# sampled or not) and keeps unsampled frames byte-identical to a wire
+# with tracing off: the determinism anchor costs nothing at rate 0.
+_FLAG_TRACE = 2
+_TRACE_SIDECAR = struct.Struct("!Qddd")
 _COMP_CODES = {COMP_NONE: 0, COMP_ZLIB: 1, COMP_ZSTD: 2}
 _COMP_NAMES = {v: k for k, v in _COMP_CODES.items()}
 # Arrays at least this big go on the socket as memoryviews (zero-copy);
@@ -330,9 +345,16 @@ class TreePacker:
         self.last_raw_len = 0
         self.last_payload_len = 0
 
-    def pack(self, obj: Any) -> List[Any]:
+    def pack(
+        self, obj: Any, *, trace: Optional[TraceStamp] = None
+    ) -> List[Any]:
         """Payload as a list of bytes-like parts (feed to
-        ``transport.send_frame_parts`` or ``b"".join`` for storage)."""
+        ``transport.send_frame_parts`` or ``b"".join`` for storage).
+
+        ``trace`` (a sampled batch's ``obs.trace.TraceStamp``) rides as the
+        fixed-size sidecar; the packer stamps ``t_encode_end`` itself once
+        the body parts (and any compression) are built — encode cannot be
+        timed from outside the payload that carries the timing."""
         leaves: List = []
         schema = _describe(obj, (), self.config.encoding, leaves)
         sjson = json.dumps(schema, separators=(",", ":")).encode()
@@ -367,14 +389,27 @@ class TreePacker:
                     compressed.append(chunk)
             compressed.append(c.flush())
             body_parts = compressed
+        flags = _FLAG_SCHEMA_INLINE if inline else 0
+        if trace is not None:
+            flags |= _FLAG_TRACE
         head = _PAYLOAD_HEADER.pack(
             WIRE_VERSION,
             _COMP_CODES[comp],
-            _FLAG_SCHEMA_INLINE if inline else 0,
+            flags,
             0,
             schema_id,
             raw_len,
         )
+        if trace is not None:
+            # Stamped HERE, after the schema walk / body build / compression
+            # above: the encode hop ends where the sidecar is written.
+            trace.t_encode_end = time.time()
+            head += _TRACE_SIDECAR.pack(
+                int(trace.trace_id) & 0xFFFFFFFFFFFFFFFF,
+                float(trace.t_collect_start),
+                float(trace.t_collect_end),
+                float(trace.t_encode_end),
+            )
         if inline:
             head += _SCHEMA_LEN.pack(len(sjson)) + sjson
         parts = [head, *body_parts]
@@ -473,6 +508,10 @@ class TreeUnpacker:
         self._schemas: Dict[int, Any] = {}
         self.last_raw_len = 0
         self.last_payload_len = 0
+        # The most recent frame's trace sidecar (None when unsampled) —
+        # the receiver reads it right after unpack() to record the
+        # actor-side hops (fleet/ingest.py).
+        self.last_trace: Optional[TraceStamp] = None
 
     def unpack(self, payload: bytes) -> Any:
         if len(payload) < HEADER_BYTES:
@@ -497,6 +536,18 @@ class TreeUnpacker:
                 f"ceiling {self.max_frame_bytes}B"
             )
         off = HEADER_BYTES
+        self.last_trace = None
+        if flags & _FLAG_TRACE:
+            if len(payload) < off + _TRACE_SIDECAR.size:
+                raise WireFormatError("truncated trace sidecar")
+            tid, t0, t1, t2 = _TRACE_SIDECAR.unpack_from(payload, off)
+            off += _TRACE_SIDECAR.size
+            self.last_trace = TraceStamp(
+                trace_id=tid,
+                t_collect_start=t0,
+                t_collect_end=t1,
+                t_encode_end=t2,
+            )
         if flags & _FLAG_SCHEMA_INLINE:
             if len(payload) < off + _SCHEMA_LEN.size:
                 raise WireFormatError("truncated schema length")

@@ -11,6 +11,12 @@ One process-wide namespace for every subsystem's operator signals:
   ``flight.jsonl`` on exit/abort (``flight_event(kind, **fields)``).
 - ``watchdog``  — NaN/Inf + grad/param-norm checks riding the log
   cadence's existing batched ``device_get``; trips abort loudly.
+- ``trace``     — sampled experience-path hop spans (collect -> ... ->
+  learn) feeding ``r2d2dpg_trace_*_seconds`` histograms and the flight
+  recorder's ``trace.json`` dump.
+- ``RemoteMirror`` / ``allgather_into_mirror`` — other processes'
+  registry snapshots merged into this process's exporter: ONE scrape
+  point per fleet (fed by fleet TELEM frames or an SPMD allgather).
 
 See docs/OBSERVABILITY.md for the naming scheme, endpoints, event schema
 and thresholds.
@@ -33,8 +39,14 @@ from r2d2dpg_tpu.obs.registry import (
     Gauge,
     Histogram,
     Registry,
+    RemoteMirror,
+    allgather_into_mirror,
     get_registry,
+    get_remote_mirror,
+    merge_remote,
+    render_prometheus,
 )
+from r2d2dpg_tpu.obs import trace  # noqa: F401 - obs.trace.* is the span API
 from r2d2dpg_tpu.obs.watchdog import (
     DivergenceError,
     DivergenceWatchdog,
@@ -50,12 +62,18 @@ __all__ = [
     "Histogram",
     "MetricsExporter",
     "Registry",
+    "RemoteMirror",
     "WatchdogConfig",
+    "allgather_into_mirror",
     "current_exporter",
     "flight_event",
     "get_flight_recorder",
     "get_registry",
+    "get_remote_mirror",
+    "merge_remote",
+    "render_prometheus",
     "set_flight_identity",
     "start_exporter",
     "stop_exporter",
+    "trace",
 ]

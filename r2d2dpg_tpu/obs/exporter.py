@@ -10,6 +10,20 @@ is live):
 - ``GET /metrics.json``   the registry's typed JSON snapshot.
 - ``GET /healthz``        ``ok`` (liveness only).
 
+One scrape point per FLEET (ISSUE 6): the exporter also merges a
+``RemoteMirror`` — other processes' registry snapshots, fed by the fleet
+ingest server's TELEM frames and/or the SPMD ``allgather_into_mirror`` —
+so the learner's ``/metrics`` page carries every actor's series under
+``actor=<id>``/``host=`` labels.  ``start_exporter`` wires the process
+mirror singleton by default; constructing ``MetricsExporter`` directly
+(tests) stays registry-only unless a mirror is passed.
+
+Hardening: a scrape must never 500 because one instrument is broken —
+per-instrument/per-family isolation lives in ``Registry.snapshot`` and
+``render_prometheus`` (bad series become ``# ... omitted`` comments), and
+the handler's outer guard turns anything that still escapes into a plain
+500 body without killing the server thread.
+
 No dependencies beyond ``http.server``; the server thread is a daemon so
 it never blocks process exit, and ``start_exporter`` is a process
 singleton — train and serve CLIs call it with ``--obs-port`` (0 = bind an
@@ -24,31 +38,65 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from r2d2dpg_tpu.obs.registry import Registry, get_registry
+from r2d2dpg_tpu.obs.registry import (
+    Registry,
+    RemoteMirror,
+    get_registry,
+    get_remote_mirror,
+    merge_remote,
+    render_prometheus,
+)
 
 
 class MetricsExporter:
-    """Serve one registry over HTTP until ``stop()`` (or process exit)."""
+    """Serve one registry (+ optional remote mirror) over HTTP until
+    ``stop()`` (or process exit)."""
 
     def __init__(
-        self, registry: Registry, port: int = 0, host: str = "0.0.0.0"
+        self,
+        registry: Registry,
+        port: int = 0,
+        host: str = "0.0.0.0",
+        mirror: Optional[RemoteMirror] = None,
     ):
         self.registry = registry
+        self.mirror = mirror
         exporter = self
+
+        def merged_snapshot():
+            snap = exporter.registry.snapshot()
+            if exporter.mirror is not None:
+                sources = exporter.mirror.sources()
+                if sources:
+                    snap = merge_remote(snap, sources)
+            return snap
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 - http.server API
                 path = self.path.split("?")[0]
-                if path == "/metrics":
-                    body = exporter.registry.prometheus_text().encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif path in ("/metrics.json", "/snapshot"):
-                    body = json.dumps(exporter.registry.snapshot()).encode()
-                    ctype = "application/json"
-                elif path == "/healthz":
-                    body, ctype = b"ok\n", "text/plain"
-                else:
-                    self.send_error(404)
+                try:
+                    if path == "/metrics":
+                        body = render_prometheus(merged_snapshot()).encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    elif path in ("/metrics.json", "/snapshot"):
+                        body = json.dumps(
+                            merged_snapshot(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                    elif path == "/healthz":
+                        body, ctype = b"ok\n", "text/plain"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:  # noqa: BLE001 - never kill the thread
+                    # Last-resort guard (per-series isolation already lives
+                    # in snapshot/render): a plain 500, server still alive.
+                    try:
+                        self.send_error(
+                            500, f"scrape failed: {type(e).__name__}"
+                        )
+                    except OSError:
+                        pass
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
@@ -83,6 +131,7 @@ def start_exporter(
     port: int = 0,
     registry: Optional[Registry] = None,
     host: str = "0.0.0.0",
+    mirror: Optional[RemoteMirror] = None,
 ) -> MetricsExporter:
     """Start (or return) THE process exporter.
 
@@ -90,7 +139,9 @@ def start_exporter(
     one process, one scrape point — regardless of the requested
     port/host.  ``host`` defaults to all interfaces (a scrape endpoint
     exists to be scraped); pass ``127.0.0.1`` (``--obs-host``) to keep it
-    loopback-only on shared hosts."""
+    loopback-only on shared hosts.  The process ``RemoteMirror`` singleton
+    is merged by default (it is empty unless a fleet ingest server or an
+    SPMD allgather feeds it)."""
     global _exporter
     with _lock:
         if _exporter is None:
@@ -98,6 +149,7 @@ def start_exporter(
                 registry if registry is not None else get_registry(),
                 port,
                 host,
+                mirror if mirror is not None else get_remote_mirror(),
             )
         return _exporter
 

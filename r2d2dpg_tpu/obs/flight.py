@@ -22,6 +22,19 @@ stamps process-wide fields (``process_index`` for
 ``parallel.distributed.initialize()`` hosts, ``actor`` for fleet actor
 subprocesses) onto every subsequent event; ``pid`` is always stamped.
 
+**Span ring** (ISSUE 6): next to the event ring lives a second bounded
+ring of experience-path *spans* — ``record_span(hop, trace_id, t_wall,
+dur_s, ...)``, fed by ``obs/trace.py``'s sampled hop recorder.  Spans dump
+as a Chrome-trace/Perfetto ``trace.json`` (``dump_trace``; armed next to
+``flight.jsonl`` by ``install``), so "why does the learner wait 0.5 s"
+loads straight into chrome://tracing.
+
+**Fleet timeline merge** (CLI): each process of a fleet dumps its own
+``flight*.jsonl``; ``python -m r2d2dpg_tpu.obs.flight merge <dir|file>...``
+concatenates them sorted by ``t_wall`` into one attributable timeline
+(the identity stamps say who recorded each line).  The trace dumper
+reuses the same sort.
+
 Hard crashes (SIGSEGV & friends) cannot run Python: ``install()`` also
 points ``faulthandler`` at a sidecar ``<path>.fault`` file so native
 tracebacks land next to the last dumped ring.
@@ -31,25 +44,66 @@ from __future__ import annotations
 
 import atexit
 import faulthandler
+import glob
 import json
 import os
+import sys
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+def sort_by_twall(events: Iterable[Dict]) -> List[Dict]:
+    """THE fleet-timeline ordering: stable sort on wall-clock seconds.
+
+    Shared by the merge CLI (N processes' flight dumps -> one timeline)
+    and the Chrome-trace dumper (spans -> ordered traceEvents)."""
+    return sorted(events, key=lambda e: float(e.get("t_wall", 0.0)))
+
+
+def chrome_trace(spans: Iterable[Dict]) -> Dict:
+    """Spans -> a Chrome Trace Event Format document (Perfetto loads it).
+
+    Each span becomes one complete event (``ph: "X"``): rows group by the
+    recording pid, and ``tid`` is the trace id (one lane per sampled
+    batch) so a batch's collect->learn hops read left to right."""
+    events = []
+    for s in sort_by_twall(spans):
+        args = {
+            k: v
+            for k, v in s.items()
+            if k not in ("hop", "t_wall", "dur_s", "pid", "trace_id")
+        }
+        args["trace_id"] = s.get("trace_id", 0)
+        events.append(
+            {
+                "name": str(s.get("hop", "span")),
+                "cat": "experience",
+                "ph": "X",
+                "ts": float(s.get("t_wall", 0.0)) * 1e6,
+                "dur": max(float(s.get("dur_s", 0.0)), 0.0) * 1e6,
+                "pid": int(s.get("pid", 0)),
+                "tid": int(s.get("trace_id", 0)) & 0x7FFFFFFF,
+                "args": args,
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 class FlightRecorder:
-    """Bounded in-memory event ring + JSONL dump."""
+    """Bounded in-memory event + span rings + JSONL/trace.json dumps."""
 
-    def __init__(self, capacity: int = 512):
+    def __init__(self, capacity: int = 512, span_capacity: int = 2048):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = capacity
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=capacity)
+        self._spans: deque = deque(maxlen=max(span_capacity, 1))
         self._seq = 0
         self._installed_path: Optional[str] = None
+        self._trace_path: Optional[str] = None
         self._fault_file = None
         self._identity: Dict[str, object] = {}
 
@@ -91,6 +145,33 @@ class FlightRecorder:
         with self._lock:
             self._ring.clear()
 
+    # ----------------------------------------------------------------- spans
+    def record_span(
+        self, hop: str, trace_id: int, t_wall: float, dur_s: float, **attrs
+    ) -> None:
+        """One experience-path hop of one sampled batch (obs/trace.py is
+        the recording API; this is the storage).  A deque append under the
+        lock — same cost class as ``record``."""
+        span = {
+            "hop": str(hop),
+            "trace_id": int(trace_id),
+            "t_wall": float(t_wall),
+            "dur_s": float(dur_s),
+            "pid": os.getpid(),
+        }
+        with self._lock:
+            span.update(self._identity)
+            span.update({k: v for k, v in attrs.items() if v is not None})
+            self._spans.append(span)
+
+    def spans(self) -> List[Dict]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear_spans(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
     # ------------------------------------------------------------------ dump
     def dump(self, path: Optional[str] = None) -> Optional[str]:
         """Write the ring as JSONL (atomic tmp+rename).  Returns the path,
@@ -99,27 +180,39 @@ class FlightRecorder:
         if path is None:
             return None
         events = self.events()
-        d = os.path.dirname(os.path.abspath(path))
-        os.makedirs(d, exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            for e in events:
-                f.write(json.dumps(e, default=str) + "\n")
-        os.replace(tmp, path)
+        _atomic_write(
+            path, "".join(json.dumps(e, default=str) + "\n" for e in events)
+        )
+        return path
+
+    def dump_trace(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the span ring as Chrome-trace JSON (atomic).  Returns the
+        path, or None when no path is known OR no spans were recorded — an
+        untraced run never litters an empty trace.json."""
+        path = path or self._trace_path
+        spans = self.spans()
+        if path is None or not spans:
+            return None
+        _atomic_write(path, json.dumps(chrome_trace(spans), default=str))
         return path
 
     # --------------------------------------------------------------- install
     def install(self, path: str) -> None:
-        """Arm exit-time capture: dump to ``path`` at interpreter exit and
-        route hard-crash native tracebacks to ``<path>.fault``.
+        """Arm exit-time capture: dump to ``path`` at interpreter exit,
+        spans to ``trace.json`` next to it, and route hard-crash native
+        tracebacks to ``<path>.fault``.
 
         Idempotent per path; re-installing with a new path re-targets the
         dump (one atexit hook either way).  Watchdog/abort paths call
-        ``dump()`` explicitly — atexit is the safety net, not the contract.
+        ``dump()``/``dump_trace()`` explicitly — atexit is the safety net,
+        not the contract.
         """
         with self._lock:
             first = self._installed_path is None
             self._installed_path = path
+            self._trace_path = os.path.join(
+                os.path.dirname(os.path.abspath(path)), "trace.json"
+            )
         if first:
             atexit.register(self._atexit_dump)
         # faulthandler can't run Python on SIGSEGV; give it a sidecar file
@@ -136,8 +229,18 @@ class FlightRecorder:
     def _atexit_dump(self) -> None:
         try:
             self.dump()
+            self.dump_trace()
         except OSError:
             pass  # exit-time best effort: never turn teardown into a crash
+
+
+def _atomic_write(path: str, content: str) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(content)
+    os.replace(tmp, path)
 
 
 _RECORDER = FlightRecorder()
@@ -159,3 +262,94 @@ def set_flight_identity(**fields) -> None:
     interleave multiple processes' ``flight.jsonl`` dumps by wall time and
     still attribute each line."""
     _RECORDER.set_identity(**fields)
+
+
+# ----------------------------------------------------------------- merge CLI
+def expand_flight_paths(paths: Iterable[str]) -> List[str]:
+    """Resolve the merge CLI's arguments: files pass through, directories
+    expand to their ``flight*.jsonl`` dumps (a fleet logdir holds the
+    learner's ``flight.jsonl`` plus one ``flight_actorN.jsonl`` each)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "flight*.jsonl"))))
+        else:
+            out.append(p)
+    return out
+
+
+def merge_flight_files(paths: Iterable[str]) -> Tuple[List[Dict], int]:
+    """N processes' flight dumps -> one ``t_wall``-ordered fleet timeline,
+    plus the count of lines that could not be parsed.
+
+    Each event is stamped with its source file (``file``) on top of the
+    identity fields it already carries; unparseable lines are skipped and
+    COUNTED rather than aborting a post-mortem over one torn line — the
+    CLI reports the count so a truncated timeline is never mistaken for a
+    complete one."""
+    events: List[Dict] = []
+    skipped = 0
+    for path in paths:
+        name = os.path.basename(path)
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    skipped += 1
+                    continue
+                if isinstance(e, dict):
+                    e.setdefault("file", name)
+                    events.append(e)
+                else:
+                    skipped += 1
+    return sort_by_twall(events), skipped
+
+
+def main(argv=None) -> None:
+    """``python -m r2d2dpg_tpu.obs.flight merge <dir|file>... [-o OUT]``"""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m r2d2dpg_tpu.obs.flight",
+        description="flight-recorder tooling (docs/OBSERVABILITY.md)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    m = sub.add_parser(
+        "merge",
+        help="interleave N processes' flight*.jsonl dumps by t_wall into "
+        "one attributable fleet timeline",
+    )
+    m.add_argument(
+        "paths", nargs="+",
+        help="flight .jsonl files and/or run dirs (dirs expand to their "
+        "flight*.jsonl dumps)",
+    )
+    m.add_argument(
+        "-o", "--out", default=None,
+        help="write the merged JSONL here (default: stdout)",
+    )
+    args = p.parse_args(argv)
+    paths = expand_flight_paths(args.paths)
+    if not paths:
+        raise SystemExit("flight merge: no flight*.jsonl files found")
+    merged, skipped = merge_flight_files(paths)
+    body = "".join(json.dumps(e, default=str) + "\n" for e in merged)
+    skip_note = f" ({skipped} unparseable lines skipped)" if skipped else ""
+    if args.out:
+        _atomic_write(args.out, body)
+        sys.stderr.write(
+            f"flight merge: {len(merged)} events from {len(paths)} files"
+            f"{skip_note} -> {args.out}\n"
+        )
+    else:
+        sys.stdout.write(body)
+        if skip_note:
+            sys.stderr.write(f"flight merge:{skip_note}\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Experience-path tracing (ISSUE 6 leg 2): where a sequence spends its time.
+
+The fleet bench shows the learner STARVING (wait p99 ~0.5 s) but nothing
+says WHERE the actor->learner path loses it: collection, the wire, the
+staging queue, or the drain itself.  This module names the hops and gives
+each one a latency histogram plus a sampled span:
+
+::
+
+    collect -> encode -> transit -> decode -> enqueue -> coalesce
+                                                -> arena_add -> learn
+
+- ``collect``    actor's collect phase compute + the host fetch of the
+                 emitted batch (fleet/actor.py).
+- ``encode``     ``wire.TreePacker.pack`` (schema walk + body parts +
+                 optional compression).
+- ``transit``    last packed byte to the learner's ``recv_frame`` return —
+                 socket time INCLUDING the one-batch-in-flight
+                 backpressure wait.  Crosses processes: actor and learner
+                 wall clocks on one host agree to ~ms; durations are
+                 clamped at 0 so skew never yields negative hops.
+- ``decode``     ``wire.TreeUnpacker.unpack`` on the handler thread.
+- ``enqueue``    staging-queue residency: decode end to the drain loop's
+                 ``queue.get`` return (``_put_or_shed`` waits included).
+- ``coalesce``   host-side batch assembly: backlog pull + ``stack_staged``.
+- ``arena_add``  the drain call's dispatch window — dominated by the
+                 host->device transfer of the staged batch (the in-graph
+                 scatter itself is fused into the learn program).
+- ``learn``      dispatch return to ``block_until_ready``: device
+                 execution of the fused add + K-update drain program.
+
+The hops are CONTIGUOUS intervals, so their sum is the end-to-end
+collect->learn latency of that batch — the learner-wait budget becomes
+attributable per hop (Podracer's per-stage accounting, PAPERS.md
+2104.06272).  The in-process pipelined executor records the subset that
+exists without a wire: collect, enqueue, arena_add, learn.
+
+**Sampling**: ``maybe_start(rate)`` decides per staged batch at collection
+time.  The default rate is 0 — no trace id is allocated, no span recorded,
+no ``block_until_ready`` added, and (for the fleet) not one extra wire
+byte: the determinism anchors hold bit-identically.  A sampled batch pays
+one ``block_until_ready`` on the learner (that is what makes the learn
+hop honest) — keep rates <= ~0.1 on runs you are measuring for throughput.
+
+Spans land in the flight recorder's bounded span ring
+(``obs/flight.py``), which dumps a Chrome-trace/Perfetto ``trace.json``
+next to ``flight.jsonl``; histograms are ``r2d2dpg_trace_<hop>_seconds``
+on the process registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Optional
+
+from r2d2dpg_tpu.obs.flight import get_flight_recorder
+from r2d2dpg_tpu.obs.registry import get_registry
+
+HOPS = (
+    "collect",
+    "encode",
+    "transit",
+    "decode",
+    "enqueue",
+    "coalesce",
+    "arena_add",
+    "learn",
+)
+
+
+@dataclasses.dataclass
+class TraceStamp:
+    """One sampled batch's identity + the actor-side hop timestamps.
+
+    The three timestamps are what crosses the wire (the fixed-size trace
+    sidecar of ``fleet/wire.py``); learner-side hops use the learner's own
+    clock reads.  Mutable on purpose: the owning stage stamps its end time
+    in place (``t_encode_end`` is stamped by the packer itself — encode
+    cannot time itself from outside the payload it produces)."""
+
+    trace_id: int
+    t_collect_start: float
+    t_collect_end: float = 0.0
+    t_encode_end: float = 0.0
+
+
+def maybe_start(sample_rate: float) -> Optional[TraceStamp]:
+    """Per-batch sampling decision at collection time.
+
+    Rate 0 (the default) returns None without touching any RNG or clock —
+    the unsampled hot path does literally nothing."""
+    if sample_rate <= 0.0:
+        return None
+    if sample_rate < 1.0 and random.random() >= sample_rate:
+        return None
+    return TraceStamp(
+        trace_id=random.getrandbits(47), t_collect_start=time.time()
+    )
+
+
+def hop_histogram(hop: str):
+    """The per-hop latency summary (registered idempotently on first use)."""
+    if hop not in HOPS:
+        raise ValueError(f"unknown trace hop {hop!r}; hops are {HOPS}")
+    return get_registry().histogram(
+        f"r2d2dpg_trace_{hop}_seconds",
+        f"experience-path '{hop}' hop latency (sampled batches only)",
+    )
+
+
+def record_hop(
+    hop: str, t_start: float, t_end: float, trace_id: int, **attrs
+) -> float:
+    """One hop of one sampled batch: histogram observation + span ring.
+
+    Durations clamp at 0 (cross-process wall clocks can skew by more than
+    a fast hop's width); the span keeps the raw start time so the dumped
+    timeline still shows true ordering.  Returns the clamped duration."""
+    dur = max(float(t_end) - float(t_start), 0.0)
+    hop_histogram(hop).observe(dur)
+    get_flight_recorder().record_span(hop, trace_id, float(t_start), dur, **attrs)
+    return dur

@@ -93,6 +93,20 @@ class StagedSequences:
     priorities: Any  # [B] float32, or None (learner-computed at drain)
 
 
+def staged_nbytes(staged: StagedSequences) -> int:
+    """Total leaf bytes of a staged batch (numpy views or device arrays).
+
+    The experience-path trace's size attribution (obs/trace.py): an
+    ``arena_add`` span carrying its batch's byte count makes a slow
+    host->device staging transfer diagnosable from trace.json alone."""
+    return int(
+        sum(
+            int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree_util.tree_leaves(staged)
+        )
+    )
+
+
 def stack_staged(batches: Sequence[StagedSequences]) -> StagedSequences:
     """Concatenate staged batches along B — the coalesced-drain payload.
 

@@ -169,7 +169,25 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument(
         "--flight-path", default=None,
         help="flight-recorder dump path (default <logdir>/flight.jsonl, "
-        "or ./flight.jsonl without --logdir)"
+        "or ./flight.jsonl without --logdir); sampled trace spans dump "
+        "to trace.json next to it"
+    )
+    p.add_argument(
+        "--obs-fleet", type=int, default=0, choices=[0, 1],
+        help="fleet-wide metric aggregation: with --actors N, actors push "
+        "~1 Hz TELEM registry snapshots that fold into this process's "
+        "/metrics under actor=/host= labels (one scrape point per fleet, "
+        "with per-actor staleness gauges); on a multi-process SPMD run, "
+        "registry scalars process_allgather into process 0's exporter"
+    )
+    p.add_argument(
+        "--trace-sample", type=float, default=0.0, metavar="RATE",
+        help="experience-path tracing: sample this fraction of staged "
+        "batches and record per-hop spans (collect -> encode -> transit "
+        "-> decode -> enqueue -> coalesce -> arena_add -> learn) into "
+        "r2d2dpg_trace_*_seconds histograms and a Chrome-trace/Perfetto "
+        "trace.json next to flight.jsonl (0 = off: no per-sequence "
+        "overhead, wire bytes unchanged)"
     )
     p.add_argument(
         "--watchdog", type=int, default=1, choices=[0, 1],
@@ -287,6 +305,29 @@ def run(args) -> dict:
         raise SystemExit(
             "--fleet-wire/--fleet-compress/--drain-coalesce require "
             "--actors N (the in-process schedules have no fleet wire)"
+        )
+    if not 0.0 <= args.trace_sample <= 1.0:
+        raise SystemExit("--trace-sample must be in [0, 1]")
+    if args.trace_sample and not (args.actors or args.pipeline):
+        # The trace names staging-path hops; the phase-locked fused
+        # schedule has none — refuse rather than silently record nothing.
+        raise SystemExit(
+            "--trace-sample requires --actors N or --pipeline 1 (the "
+            "phase-locked fused schedule has no staging path to trace)"
+        )
+    if args.obs_fleet and not args.actors and jax.process_count() == 1:
+        raise SystemExit(
+            "--obs-fleet requires --actors N or a multi-process run "
+            "(a single process already scrapes itself on --obs-port)"
+        )
+    if args.obs_fleet and args.pipeline and jax.process_count() > 1:
+        # The COLLECTIVE allgather leg rides the fused schedule's log
+        # cadence only; the pipelined loop has no wired call site —
+        # refuse rather than silently export nothing for rank > 0.
+        raise SystemExit(
+            "--obs-fleet with --pipeline 1 is not wired on multi-process "
+            "runs (the registry allgather rides the fused schedule's log "
+            "cadence) — drop --pipeline or --obs-fleet"
         )
 
     cfg = _apply_overrides(get_config(args.config), args)
@@ -457,6 +498,11 @@ def run(args) -> dict:
                 )
                 logger.log(phase, scalars)
                 final = scalars
+                if args.obs_fleet and jax.process_count() > 1:
+                    # Multi-process leg of the fleet observability plane:
+                    # COLLECTIVE (every process logs on the same cadence),
+                    # folds rank >0 registries into process 0's exporter.
+                    obs.allgather_into_mirror()
                 if watchdog is not None:
                     # Rides the fetch above — no extra host syncs; checked
                     # AFTER the log call so the poisoned row is on disk as
@@ -589,7 +635,11 @@ def _run_pipelined(
 
     executor = PipelineExecutor(
         trainer,
-        PipelineConfig(enabled=True, queue_depth=args.pipeline_depth),
+        PipelineConfig(
+            enabled=True,
+            queue_depth=args.pipeline_depth,
+            trace_sample=args.trace_sample,
+        ),
     )
     if ckpt is not None and ckpt.save_every and ckpt.save_every > 0:
         # The state is split across two threads mid-run, so periodic saves
@@ -629,6 +679,9 @@ def _run_pipelined(
     except DivergenceError as e:
         _abort_on_divergence(e, flight, flight_path, ckpt)
     finally:
+        # Sampled spans -> trace.json next to flight.jsonl (no-op when
+        # tracing is off or no dump path is armed).
+        flight.dump_trace()
         if ckpt is not None:
             ckpt.wait()
             ckpt.close()
@@ -705,6 +758,12 @@ def _run_fleet(
         # on frames the server accepts or emit frames the server refuses.
         "--max-frame-bytes", str(learner.config.max_frame_bytes),
     ]
+    if args.obs_fleet:
+        # The ~1 Hz TELEM cadence: every actor's registry lands in THIS
+        # process's /metrics under actor=/host= labels (ISSUE 6).
+        extra += ["--telem-every", "1.0"]
+    if args.trace_sample:
+        extra += ["--trace-sample", str(args.trace_sample)]
 
     def argv_fn(i: int):
         argv = default_actor_argv(
@@ -762,6 +821,9 @@ def _run_fleet(
         # loss an orderly exit, not a crash to restart), then the server.
         supervisor.stop()
         learner.close()
+        # Sampled spans -> trace.json next to flight.jsonl (no-op when
+        # tracing is off or no dump path is armed).
+        flight.dump_trace()
         if ckpt is not None:
             ckpt.wait()
             ckpt.close()

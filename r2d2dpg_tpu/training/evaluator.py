@@ -35,6 +35,11 @@ class Evaluator:
 
     def __init__(self, env: Environment, actor: ActorNet, num_envs: int = 10):
         self.env = env
+        # Host-pool envs label their metrics per role: the eval fleet's
+        # step latencies must not interleave with the training pool's
+        # (docs/OBSERVABILITY.md r2d2dpg_envpool_* role label).
+        if hasattr(env, "set_role"):
+            env.set_role("eval")
         self.actor = actor
         self.num_envs = num_envs
         self._rollout = jax.jit(self._rollout_impl)

@@ -63,6 +63,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from r2d2dpg_tpu.obs import flight_event, get_registry
+from r2d2dpg_tpu.obs import trace as obs_trace
 from r2d2dpg_tpu.replay.arena import StagedSequences
 from r2d2dpg_tpu.training.assembler import emit
 from r2d2dpg_tpu.training.trainer import Trainer, TrainerState
@@ -81,6 +82,11 @@ class PipelineConfig:
     enabled: bool = True  # False = phase-locked control schedule
     queue_depth: int = 2  # staging-queue capacity, in collect phases
     prefetch: bool = True  # double-buffered batch sampling in the drain
+    # Experience-path trace sampling (obs/trace.py; --trace-sample).  The
+    # in-process path records the hops that exist without a wire: collect,
+    # enqueue, arena_add, learn.  0 = off — no span, no extra
+    # block_until_ready, the schedule untouched.
+    trace_sample: float = 0.0
 
 
 @jax.tree_util.register_dataclass
@@ -550,9 +556,20 @@ class PipelineExecutor:
                         break
                     if k and k % sync_every == 0:
                         behavior, critic = box.snapshot()
+                    tr = obs_trace.maybe_start(cfg.trace_sample)
                     with annotate("pipeline/collect"):
                         cs, staged = self._collect_phase_pipelined(
                             cs, behavior, critic
+                        )
+                    if tr is not None:
+                        # The collect hop ends when the staged batch is
+                        # actually materialized (async dispatch otherwise
+                        # returns immediately); sampled phases only.
+                        jax.block_until_ready(staged)
+                        tr.t_collect_end = time.time()
+                        obs_trace.record_hop(
+                            "collect", tr.t_collect_start, tr.t_collect_end,
+                            tr.trace_id,
                         )
                     gphase = phase0 + k + 1
                     ep_refs = None
@@ -577,7 +594,7 @@ class PipelineExecutor:
                             completed_return_sum=jnp.zeros(()),
                             completed_count=jnp.zeros(()),
                         )
-                    item = (gphase, staged, ep_refs)
+                    item = (gphase, staged, ep_refs, tr)
                     t_wait = time.monotonic()
                     while not stop.is_set():
                         try:
@@ -619,9 +636,26 @@ class PipelineExecutor:
                     )
                 if item is None:
                     break
-                gphase, staged, ep_refs = item
+                gphase, staged, ep_refs, tr = item
+                t_dequeue = time.time()
                 with annotate("pipeline/learn"):
                     ls, metrics = self._drain_prog(ls, staged)
+                if tr is not None:
+                    # Sampled batch: enqueue = staging-queue residency,
+                    # arena_add = the drain call's dispatch window, learn =
+                    # device execution (block_until_ready — sampled phases
+                    # only, the unsampled schedule stays fully async).
+                    t_dispatch_end = time.time()
+                    obs_trace.record_hop(
+                        "enqueue", tr.t_collect_end, t_dequeue, tr.trace_id
+                    )
+                    obs_trace.record_hop(
+                        "arena_add", t_dequeue, t_dispatch_end, tr.trace_id
+                    )
+                    jax.block_until_ready(ls.train.step)
+                    obs_trace.record_hop(
+                        "learn", t_dispatch_end, time.time(), tr.trace_id
+                    )
                 behavior_final = self._publish(
                     box, ls.train, gphase, record=ep_refs is not None
                 )

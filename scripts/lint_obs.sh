@@ -31,4 +31,43 @@ if [ -n "$offenders" ]; then
          "(or annotate deliberate sinks with '# obs-lint: allow')"
     exit 1
 fi
+
+# ---- metric naming scheme -------------------------------------------------
+# Every metric name registered in library code must follow the documented
+# r2d2dpg_<subsystem>_<metric> scheme (docs/OBSERVABILITY.md) or appear in
+# scripts/obs_metric_allowlist.txt.  A scan of literal first arguments to
+# .counter(/.gauge(/.histogram( — registrations span lines, so the scan is
+# a small python (re over whole files), not a line grep.  f-string names
+# (e.g. the per-hop trace histograms) parameterize an already-conforming
+# prefix and are out of scope for a literal scan.
+python - <<'EOF'
+import re
+import sys
+from pathlib import Path
+
+allow = set()
+allow_path = Path("scripts/obs_metric_allowlist.txt")
+if allow_path.exists():
+    for line in allow_path.read_text().splitlines():
+        line = line.strip()
+        if line and not line.startswith("#"):
+            allow.add(line)
+
+pat = re.compile(r'\.(?:counter|gauge|histogram)\(\s*"([^"]+)"')
+scheme = re.compile(r"^r2d2dpg_[a-z0-9]+_[a-z0-9_]*[a-z0-9]$")
+bad = []
+for path in sorted(Path("r2d2dpg_tpu").rglob("*.py")):
+    for name in pat.findall(path.read_text()):
+        if not scheme.match(name) and name not in allow:
+            bad.append(f"{path}: {name}")
+if bad:
+    print("\n".join(bad))
+    print(
+        "lint_obs: FAIL — metric name outside the documented "
+        "r2d2dpg_<subsystem>_<metric> scheme (docs/OBSERVABILITY.md); "
+        "rename it, or allowlist it in scripts/obs_metric_allowlist.txt "
+        "with a reason"
+    )
+    sys.exit(1)
+EOF
 echo "lint_obs: OK"

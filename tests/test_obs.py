@@ -438,30 +438,37 @@ def test_health_snapshot_publish_refits_onto_registry():
 def test_host_pool_step_registers_envpool_instruments():
     """The dm_control fleet feeds the pool="python" label set: step
     latency + lock-wait histograms and the resets counter all move.
-    Instrument registration is asserted unconditionally (it happens at
-    pool construction); the stepping assertions skip when this container
-    cannot load dm_control physics (no EGL — a known environment gap)."""
+    Instruments bind LAZILY on the first step (so a pool whose role
+    arrives after construction never registers a phantom role="train"
+    cell); assertions skip when this container cannot load dm_control
+    physics (no EGL — a known environment gap)."""
     pytest.importorskip("dm_control")
     from r2d2dpg_tpu.envs.dmc_host import _HostPool
 
     reg = obs.get_registry()
     pool = _HostPool("walker", "walk", pixels=False, camera_id=0)
-    step_h = reg.get("r2d2dpg_envpool_step_seconds").labels(pool="python")
-    lock_h = reg.get("r2d2dpg_envpool_lock_wait_seconds").labels(
-        pool="python"
-    )
-    assert reg.get("r2d2dpg_envpool_resets_total") is not None
     try:
         pool.reset_all(np.arange(2))
+        pool.step_all(np.zeros((2, 6), np.float32))  # binds instruments
     except Exception as e:  # pragma: no cover - container-dependent
         pytest.skip(f"dm_control env unavailable here: {type(e).__name__}")
+    step_h = reg.get("r2d2dpg_envpool_step_seconds").labels(
+        pool="python", role="train"
+    )
+    lock_h = reg.get("r2d2dpg_envpool_lock_wait_seconds").labels(
+        pool="python", role="train"
+    )
+    assert reg.get("r2d2dpg_envpool_resets_total") is not None
     before = step_h.count
     for _ in range(3):
         pool.step_all(np.zeros((2, 6), np.float32))
     assert step_h.count == before + 3
     assert lock_h.count >= 3
     text = reg.prometheus_text()
-    assert 'r2d2dpg_envpool_step_seconds_count{pool="python"}' in text
+    assert (
+        'r2d2dpg_envpool_step_seconds_count{pool="python",role="train"}'
+        in text
+    )
 
 
 def test_host_pool_step_instruments_move_with_stub_envs():
@@ -499,8 +506,13 @@ def test_host_pool_step_instruments_move_with_stub_envs():
     pool.envs = [_StubEnv(), _StubEnv()]
     pool.executor = ThreadPoolExecutor(max_workers=2)
     reg = obs.get_registry()
-    step_h = reg.get("r2d2dpg_envpool_step_seconds").labels(pool="python")
-    resets = reg.get("r2d2dpg_envpool_resets_total").labels(pool="python")
+    out = pool.step_all(np.zeros((2, 1), np.float32))  # binds instruments
+    step_h = reg.get("r2d2dpg_envpool_step_seconds").labels(
+        pool="python", role="train"
+    )
+    resets = reg.get("r2d2dpg_envpool_resets_total").labels(
+        pool="python", role="train"
+    )
     s0, r0 = step_h.count, resets.value
     for _ in range(4):
         out = pool.step_all(np.zeros((2, 1), np.float32))
@@ -632,3 +644,446 @@ def test_pipeline_refuses_nan_injection():
     )
     with pytest.raises(SystemExit, match="nan-inject"):
         run(args)
+
+
+# ----------------------------------------------------- envpool role label
+def test_pool_role_label_separates_instances():
+    """satellite: set_role('eval') re-binds a pool's instruments to its own
+    role cell, so the evaluator's fleet and the training fleet no longer
+    interleave into one distribution."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from r2d2dpg_tpu.envs.dmc_host import _HostPool
+
+    class _Ts:
+        def __init__(self):
+            self.reward = 0.0
+            self.discount = 1.0
+            self.observation = {"x": np.zeros(2, np.float32)}
+
+        def last(self):
+            return False
+
+    class _StubEnv:
+        def step(self, action):
+            return _Ts()
+
+        def reset(self):
+            return _Ts()
+
+    reg = obs.get_registry()
+    pool = _HostPool("walker", "walk", pixels=False, camera_id=0)
+    pool.set_role("eval")
+    pool.envs = [_StubEnv()]
+    pool.executor = ThreadPoolExecutor(max_workers=1)
+    pool.step_all(np.zeros((1, 1), np.float32))  # lazy bind: role="eval"
+    train_cell = reg.get("r2d2dpg_envpool_step_seconds").labels(
+        pool="python", role="train"
+    )
+    eval_cell = reg.get("r2d2dpg_envpool_step_seconds").labels(
+        pool="python", role="eval"
+    )
+    t0, e0 = train_cell.count, eval_cell.count
+    pool.step_all(np.zeros((1, 1), np.float32))
+    assert eval_cell.count == e0 + 1
+    assert train_cell.count == t0  # the training cell did not move
+    pool.executor.shutdown(wait=False)
+
+
+def test_evaluator_sets_eval_role():
+    """The evaluator stamps its (separate) env instance role='eval'."""
+    from r2d2dpg_tpu.training.evaluator import Evaluator
+
+    class _RoleEnv:
+        batched = True
+
+        def __init__(self):
+            self.role = None
+
+        def set_role(self, role):
+            self.role = role
+
+    env = _RoleEnv()
+    # jax.jit only wraps at construction; the stub actor is never traced.
+    Evaluator(env, actor=None, num_envs=1)
+    assert env.role == "eval"
+
+
+# ------------------------------------------------------ exporter hardening
+def test_exporter_scrape_survives_raising_gauge():
+    """satellite: one bad instrument must not 500 the scrape or kill the
+    exporter thread — a raising set_fn renders NaN (value-level guard),
+    and an instrument broken at snapshot time is omitted as a comment."""
+    reg = Registry()
+    reg.counter("good_total").inc(1)
+
+    def boom():
+        raise RuntimeError("dead callback")
+
+    reg.gauge("bad_gauge").set_fn(boom)
+    broken = reg.gauge("broken_gauge")
+    broken.set(1.0)
+    broken._cells_snapshot = lambda: (_ for _ in ()).throw(
+        RuntimeError("snapshot exploded")
+    )
+    ex = obs.MetricsExporter(reg, port=0)
+    try:
+        base = f"http://127.0.0.1:{ex.port}"
+        text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "good_total 1" in text  # scrape intact
+        assert "bad_gauge NaN" in text  # value-level guard
+        assert "# broken_gauge omitted: RuntimeError" in text
+        assert "broken_gauge 1" not in text
+        # JSON endpoint carries the error entry instead of crashing.
+        snap = json.loads(
+            urllib.request.urlopen(f"{base}/metrics.json").read()
+        )
+        assert "snapshot exploded" in snap["broken_gauge"]["error"]
+        # The server thread survived: a second scrape still answers.
+        assert (
+            urllib.request.urlopen(f"{base}/healthz").read() == b"ok\n"
+        )
+    finally:
+        ex.stop()
+
+
+def test_render_prometheus_isolates_malformed_entries():
+    """A malformed (e.g. remote) snapshot entry becomes an omitted-comment
+    line; well-formed families render unaffected."""
+    snap = {
+        "ok_total": {
+            "kind": "counter",
+            "help": "fine",
+            "samples": [{"labels": {}, "value": 2.0}],
+        },
+        "bad entry name": {"kind": "counter", "samples": []},
+        "half_formed": {"kind": "histogram", "samples": [{"labels": {}}]},
+    }
+    text = obs.render_prometheus(snap)
+    assert "ok_total 2" in text
+    assert "# bad entry name omitted:" in text
+    assert "# half_formed sample omitted: KeyError" in text
+
+
+def test_render_prometheus_bad_remote_sample_keeps_local_series():
+    """One malformed REMOTE sample merged into a healthy local family
+    (version-skewed actor) omits only itself — the learner's own local
+    samples of that family still render."""
+    base = Registry()
+    base.histogram("r2d2dpg_envpool_step_seconds").observe(0.5)
+    skewed = {
+        "r2d2dpg_envpool_step_seconds": {
+            "kind": "histogram",
+            # A histogram sample missing p99 AND a gauge-shaped sample
+            # under a histogram family.
+            "samples": [
+                {"labels": {}, "count": 1, "total": 0.1, "p50": 0.1},
+                {"labels": {}, "value": 3.0},
+            ],
+        }
+    }
+    merged = obs.merge_remote(
+        base.snapshot(), [("actor:0", {"actor": "0"}, skewed)]
+    )
+    text = obs.render_prometheus(merged)
+    # Local series survive the bad remote samples...
+    assert "r2d2dpg_envpool_step_seconds_count 1" in text
+    assert 'r2d2dpg_envpool_step_seconds{quantile="0.5"} 0.5' in text
+    # ...which are omitted individually, not the whole family.
+    assert text.count("# r2d2dpg_envpool_step_seconds sample omitted:") == 2
+    assert text.count("# TYPE r2d2dpg_envpool_step_seconds") == 1
+
+
+def test_merge_remote_forwards_remote_instrument_errors():
+    """A remote instrument that failed at snapshot time (Registry.snapshot's
+    per-instrument isolation -> an ``error`` entry) must surface in the
+    merged scrape as an ATTRIBUTED sample-omitted comment — never vanish,
+    and never omit other sources' healthy series sharing the family."""
+    base = Registry()
+    base.gauge("r2d2dpg_x_gauge").set(1.0)
+    broken = {
+        # Shares a family with a healthy local series...
+        "r2d2dpg_x_gauge": {
+            "kind": "gauge",
+            "help": "",
+            "error": "RuntimeError: boom",
+            "samples": [],
+        },
+        # ...and one that exists ONLY remotely.
+        "r2d2dpg_y_gauge": {"kind": "gauge", "error": "dead", "samples": []},
+    }
+    merged = obs.merge_remote(
+        base.snapshot(), [("actor:0", {"actor": "0"}, broken)]
+    )
+    text = obs.render_prometheus(merged)
+    assert "r2d2dpg_x_gauge 1" in text  # local series survives
+    assert "# r2d2dpg_x_gauge sample omitted:" in text
+    assert "boom" in text and 'actor="0"' in text  # attributed, visible
+    assert "# r2d2dpg_y_gauge sample omitted:" in text
+    assert "dead" in text
+
+
+def test_render_prometheus_neutralizes_newlines_from_remote_strings():
+    """Remote-supplied names/label keys/values with embedded newlines must
+    not tear the exposition into forged lines: values get the ``\\n``
+    escape, bad names/keys become single-line omitted comments."""
+    snap = {
+        "bad\nname_total": {
+            "kind": "counter",
+            "samples": [{"labels": {}, "value": 1.0}],
+        },
+        "r2d2dpg_ok_gauge": {
+            "kind": "gauge",
+            "samples": [
+                {"labels": {"host": "h1\nup 1"}, "value": 2.0},
+                {"labels": {"bad\nkey": "v"}, "value": 3.0},
+            ],
+        },
+    }
+    text = obs.render_prometheus(snap)
+    # Every line is either a comment or a well-formed ok_gauge sample —
+    # no forged "up 1" series line ever appears.
+    assert "up 1" not in text.splitlines()
+    for line in text.splitlines():
+        assert line.startswith("#") or line.startswith("r2d2dpg_ok_gauge")
+    assert 'host="h1\\nup 1"' in text  # value escaped, not emitted raw
+    assert "# bad name_total omitted:" in text  # name flattened to one line
+    assert "# r2d2dpg_ok_gauge sample omitted:" in text  # bad label key
+    assert 'r2d2dpg_ok_gauge{host="h1\\nup 1"} 2' in text
+
+
+# ----------------------------------------------------- remote mirror (leg 1)
+def test_remote_mirror_update_is_idempotent_and_tracks_staleness():
+    m = obs.RemoteMirror()
+    reg = Registry()
+    reg.counter("r2d2dpg_actor_phases_total").inc(3)
+    m.update("actor:0", {"actor": "0"}, reg.snapshot())
+    m.update("actor:0", {"actor": "0"}, reg.snapshot())  # reconnect: same slot
+    assert len(m.sources()) == 1
+    assert m.staleness_s("actor:0") is not None
+    assert m.staleness_s("actor:0") < 5.0
+    assert m.staleness_s("actor:9") is None
+    with pytest.raises(TypeError):
+        m.update("actor:1", {}, "not a snapshot")
+    m.drop("actor:0")
+    assert m.sources() == []
+
+
+def test_merge_remote_attribution_labels_win():
+    base = Registry()
+    base.counter("r2d2dpg_fleet_frames_total", labelnames=("actor",)).labels(
+        actor="learner-side"
+    ).inc(1)
+    remote = Registry()
+    remote.counter("r2d2dpg_actor_phases_total").inc(7)
+    remote.gauge("r2d2dpg_x_gauge", labelnames=("actor",)).labels(
+        actor="lying"
+    ).set(1.0)
+    merged = obs.merge_remote(
+        base.snapshot(), [("actor:0", {"actor": "0", "host": "h1"}, remote.snapshot())]
+    )
+    text = obs.render_prometheus(merged)
+    # Remote unlabelled series gain the attribution labels...
+    assert 'r2d2dpg_actor_phases_total{actor="0",host="h1"} 7' in text
+    # ...and the aggregator's labels WIN a collision (who-reported truth).
+    assert 'r2d2dpg_x_gauge{actor="0",host="h1"} 1' in text
+    # Base samples are untouched, one TYPE line per family.
+    assert 'r2d2dpg_fleet_frames_total{actor="learner-side"} 1' in text
+    assert text.count("# TYPE r2d2dpg_fleet_frames_total") == 1
+
+
+def test_exporter_merges_mirror_sources():
+    reg = Registry()
+    reg.counter("local_total").inc(1)
+    remote = Registry()
+    remote.counter("r2d2dpg_actor_phases_total").inc(5)
+    mirror = obs.RemoteMirror()
+    mirror.update("actor:1", {"actor": "1"}, remote.snapshot())
+    ex = obs.MetricsExporter(reg, port=0, mirror=mirror)
+    try:
+        text = (
+            urllib.request.urlopen(f"http://127.0.0.1:{ex.port}/metrics")
+            .read()
+            .decode()
+        )
+        assert "local_total 1" in text
+        assert 'r2d2dpg_actor_phases_total{actor="1"} 5' in text
+    finally:
+        ex.stop()
+
+
+def test_allgather_into_mirror_single_process_is_noop():
+    m = obs.RemoteMirror()
+    assert obs.allgather_into_mirror(Registry(), m) == 0
+    assert m.sources() == []
+
+
+# ------------------------------------------------------------ trace (leg 2)
+def test_trace_sampling_and_hop_recording():
+    from r2d2dpg_tpu.obs import trace as obs_trace
+
+    assert obs_trace.maybe_start(0.0) is None  # default: literally nothing
+    tr = obs_trace.maybe_start(1.0)
+    assert tr is not None and tr.t_collect_start > 0
+    with pytest.raises(ValueError, match="unknown trace hop"):
+        obs_trace.hop_histogram("teleport")
+    fr = obs.get_flight_recorder()
+    n0 = len(fr.spans())
+    dur = obs_trace.record_hop("collect", 10.0, 10.5, tr.trace_id, actor="0")
+    assert dur == 0.5
+    # Clock skew across processes clamps at zero, never negative.
+    assert obs_trace.record_hop("transit", 11.0, 10.9, tr.trace_id) == 0.0
+    spans = fr.spans()
+    assert len(spans) == n0 + 2
+    assert spans[-2]["hop"] == "collect" and spans[-2]["actor"] == "0"
+    hist = obs.get_registry().get("r2d2dpg_trace_collect_seconds")
+    assert hist is not None and hist.count >= 1
+
+
+def test_flight_dump_trace_chrome_format(tmp_path):
+    fr = obs.FlightRecorder()
+    assert fr.dump_trace() is None  # nothing armed, nothing recorded
+    fr.record_span("collect", 7, 100.0, 0.25, actor="0")
+    fr.record_span("learn", 7, 100.5, 0.1)
+    path = str(tmp_path / "trace.json")
+    assert fr.dump_trace(path) == path
+    doc = json.loads(open(path).read())
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert names == ["collect", "learn"]  # t_wall-ordered
+    ev = doc["traceEvents"][0]
+    assert ev["ph"] == "X" and ev["ts"] == 100.0 * 1e6
+    assert ev["dur"] == 0.25 * 1e6 and ev["tid"] == 7
+    assert ev["args"]["actor"] == "0"
+    # install() arms trace.json NEXT TO the flight path.
+    fr2 = obs.FlightRecorder()
+    fr2.install(str(tmp_path / "run" / "flight.jsonl"))
+    fr2.record_span("decode", 1, 1.0, 0.1)
+    assert fr2.dump_trace() == str(tmp_path / "run" / "trace.json")
+
+
+# ------------------------------------------------------- flight merge tool
+def test_flight_merge_tool_interleaves_by_t_wall(tmp_path):
+    """satellite: `python -m r2d2dpg_tpu.obs.flight merge <dir>` replaces
+    the docs' manual cat|sort recipe — one attributable fleet timeline."""
+    from r2d2dpg_tpu.obs import flight as flight_mod
+
+    d = tmp_path / "run"
+    d.mkdir()
+    (d / "flight.jsonl").write_text(
+        json.dumps({"kind": "a", "t_wall": 2.0, "process_index": 0}) + "\n"
+        + json.dumps({"kind": "c", "t_wall": 4.0, "process_index": 0}) + "\n"
+    )
+    (d / "flight_actor0.jsonl").write_text(
+        "garbage-line\n"
+        + json.dumps({"kind": "b", "t_wall": 3.0, "actor": 0}) + "\n"
+        + json.dumps({"kind": "z", "t_wall": 1.0, "actor": 0}) + "\n"
+    )
+    paths = flight_mod.expand_flight_paths([str(d)])
+    assert [os.path.basename(p) for p in paths] == [
+        "flight.jsonl", "flight_actor0.jsonl",
+    ]
+    merged, skipped = flight_mod.merge_flight_files(paths)
+    assert [e["kind"] for e in merged] == ["z", "a", "b", "c"]
+    assert skipped == 1  # the garbage line is counted, not silently lost
+    assert merged[0]["file"] == "flight_actor0.jsonl"  # attribution stamp
+    out = str(tmp_path / "merged.jsonl")
+    flight_mod.main(["merge", str(d), "-o", out])
+    lines = [json.loads(l) for l in open(out)]
+    assert [e["kind"] for e in lines] == ["z", "a", "b", "c"]
+    # The module CLI entry point works end to end.
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",  # keep the axon plugin out of the child
+    }
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        ["python", "-m", "r2d2dpg_tpu.obs.flight", "merge", str(d)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env=env,
+    )
+    assert res.returncode == 0, res.stderr
+    assert [json.loads(l)["kind"] for l in res.stdout.splitlines()] == [
+        "z", "a", "b", "c",
+    ]
+
+
+# ------------------------------------------------------ metric-name lint
+def test_lint_metric_scheme_catches_offender(tmp_path):
+    """satellite: a library registration outside the documented
+    r2d2dpg_<subsystem>_<metric> scheme fails the lint (allowlist file
+    honored)."""
+    import shutil
+
+    tree = tmp_path / "repo"
+    (tree / "scripts").mkdir(parents=True)
+    shutil.copy(
+        os.path.join(REPO, "scripts", "lint_obs.sh"), tree / "scripts"
+    )
+    pkg = tree / "r2d2dpg_tpu"
+    pkg.mkdir()
+    (pkg / "offender.py").write_text(
+        "def setup(reg):\n"
+        "    return reg.counter(\n"
+        '        "my_rogue_metric", "spans lines like real registrations"\n'
+        "    )\n"
+    )
+    res = subprocess.run(
+        ["bash", str(tree / "scripts" / "lint_obs.sh")],
+        capture_output=True,
+        text=True,
+    )
+    assert res.returncode == 1
+    assert "my_rogue_metric" in res.stdout
+    # Allowlisting the name (with the file's comment contract) passes it.
+    (tree / "scripts" / "obs_metric_allowlist.txt").write_text(
+        "# fixture exemption\nmy_rogue_metric\n"
+    )
+    res = subprocess.run(
+        ["bash", str(tree / "scripts" / "lint_obs.sh")],
+        capture_output=True,
+        text=True,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+# ------------------------------------------------------- train.py refusals
+def test_train_cli_refuses_orphan_obs_fleet_and_trace_flags():
+    from r2d2dpg_tpu.train import parse_args, run
+
+    with pytest.raises(SystemExit, match="requires --actors"):
+        run(parse_args(["--config", "pendulum_tiny", "--obs-fleet", "1"]))
+    with pytest.raises(SystemExit, match="requires --actors N or --pipeline"):
+        run(
+            parse_args(
+                ["--config", "pendulum_tiny", "--trace-sample", "0.5"]
+            )
+        )
+    with pytest.raises(SystemExit, match="must be in"):
+        run(
+            parse_args(
+                [
+                    "--config", "pendulum_tiny",
+                    "--pipeline", "1",
+                    "--trace-sample", "1.5",
+                ]
+            )
+        )
+    # Multi-process + --pipeline has no wired allgather call site: refuse
+    # rather than silently export nothing for rank > 0.
+    import jax as _jax
+
+    from unittest import mock
+
+    with mock.patch.object(_jax, "process_count", return_value=2):
+        with pytest.raises(SystemExit, match="not wired on multi-process"):
+            run(
+                parse_args(
+                    [
+                        "--config", "pendulum_tiny",
+                        "--pipeline", "1",
+                        "--obs-fleet", "1",
+                    ]
+                )
+            )

@@ -1,0 +1,326 @@
+"""Fleet-wide observability plane (ISSUE 6): TELEM metric aggregation into
+one scrape point + cross-process experience-path tracing.
+
+Leg 1: actors push ~1 Hz TELEM registry snapshots over the fleet wire;
+the ingest server folds them into the learner's RemoteMirror under
+``actor=``/``host=`` labels with per-actor staleness gauges, and the
+exporter serves ONE merged /metrics page for the whole fleet.
+
+Leg 2: sampled staged batches carry a trace sidecar (id + actor-side hop
+timestamps) through encode/socket/decode; the learner records the full
+collect -> encode -> transit -> decode -> enqueue -> coalesce ->
+arena_add -> learn span chain into hop histograms and the flight
+recorder's span ring, dumped as a Perfetto-loadable trace.json.
+"""
+
+import json
+import queue
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu import obs
+from r2d2dpg_tpu.configs import PENDULUM_TINY
+from r2d2dpg_tpu.fleet import FleetConfig, FleetLearner, IngestServer, transport, wire
+from r2d2dpg_tpu.fleet.transport import (
+    K_ACK,
+    K_HELLO,
+    K_SEQS,
+    K_TELEM,
+    pack_obj,
+    recv_frame,
+    send_frame,
+    send_frame_parts,
+    unpack_obj,
+)
+from r2d2dpg_tpu.obs.trace import HOPS
+from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
+from r2d2dpg_tpu.utils.codes import OK
+
+pytestmark = pytest.mark.fleet
+
+N_TRAIN = 10
+
+
+def _np_staged(b=2, l=3):
+    rng = np.random.default_rng(1)
+    return StagedSequences(
+        seq=SequenceBatch(
+            obs=rng.normal(size=(b, l, 3)).astype(np.float32),
+            action=rng.normal(size=(b, l, 1)).astype(np.float32),
+            reward=rng.normal(size=(b, l)).astype(np.float32),
+            discount=np.ones((b, l), np.float32),
+            reset=np.zeros((b, l), np.float32),
+            carries={},
+        ),
+        priorities=np.ones((b,), np.float32),
+    )
+
+
+def _hello(sock, actor_id):
+    send_frame(
+        sock,
+        K_HELLO,
+        pack_obj(
+            {"actor_id": actor_id, **wire.negotiation_fields(wire.WireConfig())}
+        ),
+    )
+    kind, payload = recv_frame(sock)
+    assert kind == K_ACK and unpack_obj(payload)["code"] == OK
+
+
+def _telem(sock, actor_id, snapshot, host="testhost"):
+    send_frame(
+        sock,
+        K_TELEM,
+        pack_obj(
+            {
+                "actor_id": actor_id,
+                "host": host,
+                "t_wall": time.time(),
+                "snapshot": snapshot,
+            }
+        ),
+    )
+
+
+# ----------------------------------------------------------- TELEM folding
+def test_telem_folds_reconnects_idempotently_and_goes_stale():
+    """TELEM edge cases (satellite): snapshots fold under actor=/host=
+    labels, a reconnecting actor UPDATES its slot (no duplicate sources),
+    and a silent actor's staleness gauge keeps growing instead of its
+    series lying flat."""
+    mirror = obs.get_remote_mirror()
+    mirror.clear()
+    remote = obs.Registry()
+    remote.counter("r2d2dpg_actor_phases_total").inc(11)
+    q: queue.Queue = queue.Queue(maxsize=4)
+    srv = IngestServer(q, address="127.0.0.1:0")
+    srv.start()
+    try:
+        sock = transport.connect(srv.address)
+        sock.settimeout(10)
+        _hello(sock, 3)
+        _telem(sock, 3, remote.snapshot())
+        # TELEM is fire-and-forget: prove receipt via the next SEQS ack.
+        packer = wire.TreePacker(wire.WireConfig())
+        send_frame_parts(
+            sock,
+            K_SEQS,
+            packer.pack(
+                {"phase": 1, "param_version": 0, "env_steps_delta": 0.0,
+                 "ep_return_sum": 0.0, "ep_count": 0.0, "staged": _np_staged()}
+            ),
+        )
+        kind, payload = recv_frame(sock)
+        assert kind == K_ACK and unpack_obj(payload)["code"] == OK
+        sources = mirror.sources()
+        assert len(sources) == 1
+        key, labels, snap = sources[0]
+        assert key == "actor:3"
+        assert labels == {"actor": "3", "host": "testhost"}
+        assert snap["r2d2dpg_actor_phases_total"]["samples"][0]["value"] == 11
+        reg = obs.get_registry()
+        stale = reg.get("r2d2dpg_fleet_telem_staleness_seconds").labels(
+            actor="3"
+        )
+        s0 = stale.value
+        assert s0 >= 0.0
+        time.sleep(0.06)
+        # A dead/wedged actor goes visibly STALE (gauge grows) rather than
+        # its mirrored series silently freezing without a marker.
+        assert stale.value >= s0 + 0.05
+        sock.close()
+
+        # Reconnect (supervised restart): same actor id, fresh connection —
+        # the fold re-registers idempotently; still exactly one source.
+        sock = transport.connect(srv.address)
+        sock.settimeout(10)
+        _hello(sock, 3)
+        remote.counter("r2d2dpg_actor_phases_total").inc(1)
+        _telem(sock, 3, remote.snapshot())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            sources = mirror.sources()
+            snap = sources[0][2] if sources else {}
+            if (
+                len(sources) == 1
+                and snap.get("r2d2dpg_actor_phases_total", {}).get(
+                    "samples", [{}]
+                )[0].get("value") == 12
+            ):
+                break
+            time.sleep(0.02)
+        sources = mirror.sources()
+        assert len(sources) == 1 and sources[0][0] == "actor:3"
+        sock.close()
+    finally:
+        srv.stop()
+        mirror.clear()
+
+
+def test_telem_malformed_frame_dropped_with_flight_event():
+    """A malformed TELEM frame costs one flight event, never the
+    connection: the experience path keeps flowing."""
+    mirror = obs.get_remote_mirror()
+    mirror.clear()
+    q: queue.Queue = queue.Queue(maxsize=4)
+    srv = IngestServer(q, address="127.0.0.1:0")
+    srv.start()
+    try:
+        sock = transport.connect(srv.address)
+        sock.settimeout(10)
+        _hello(sock, 5)
+        # Malformed in two ways: a non-dict payload and a dict whose
+        # snapshot is not a snapshot.
+        send_frame(sock, K_TELEM, pack_obj(["not", "a", "dict"]))
+        send_frame(sock, K_TELEM, pack_obj({"actor_id": 5, "snapshot": 42}))
+        packer = wire.TreePacker(wire.WireConfig())
+        send_frame_parts(
+            sock,
+            K_SEQS,
+            packer.pack(
+                {"phase": 1, "param_version": 0, "env_steps_delta": 0.0,
+                 "ep_return_sum": 0.0, "ep_count": 0.0, "staged": _np_staged()}
+            ),
+        )
+        kind, payload = recv_frame(sock)  # connection survived both frames
+        assert kind == K_ACK and unpack_obj(payload)["code"] == OK
+        drops = [
+            e
+            for e in obs.get_flight_recorder().events()
+            if e["kind"] == "telem_malformed" and e.get("actor") == "5"
+        ]
+        assert len(drops) >= 2
+        assert mirror.sources() == []  # nothing folded
+        # Staleness is armed at HELLO, not at the first well-formed fold:
+        # an actor that only ever sends garbage TELEM still has a GROWING
+        # staleness series instead of being silently absent.
+        stale = obs.get_registry().get(
+            "r2d2dpg_fleet_telem_staleness_seconds"
+        ).labels(actor="5")
+        assert stale.value >= 0.0
+        sock.close()
+    finally:
+        srv.stop()
+        mirror.clear()
+
+
+# ----------------------------------------------------- 2-actor e2e (accept)
+def test_fleet_obs_plane_two_actor_e2e(tmp_path):
+    """Acceptance: a 2-actor fleet run (telem + trace sampled at 1.0)
+    exposes EVERY actor's labelled series and per-actor staleness in ONE
+    scrape of the learner's /metrics, and its sampled spans cover all
+    named hops, sum to the observed end-to-end latency within ~10%, and
+    dump as a Perfetto-loadable trace.json."""
+    from r2d2dpg_tpu.fleet.actor import FleetActor
+
+    mirror = obs.get_remote_mirror()
+    mirror.clear()
+    fr = obs.get_flight_recorder()
+    fr.clear_spans()
+    trainer = PENDULUM_TINY.build()
+    learner = FleetLearner(
+        trainer, FleetConfig(num_actors=2, queue_depth=4, idle_timeout_s=60)
+    )
+    address = learner.start()
+    actors = [
+        FleetActor(
+            PENDULUM_TINY,
+            actor_id=i,
+            num_actors=2,
+            address=address,
+            seed=0,
+            telem_every=0.05,
+            trace_sample=1.0,
+        )
+        for i in range(2)
+    ]
+
+    def actor_loop(a):
+        try:
+            a.run(max_phases=400)
+        except Exception:  # noqa: BLE001 — server teardown cuts the socket
+            pass
+
+    threads = [
+        threading.Thread(target=actor_loop, args=(a,), daemon=True)
+        for a in actors
+    ]
+    for t in threads:
+        t.start()
+    try:
+        state = learner.run(N_TRAIN, log_every=0)
+    finally:
+        learner.close()
+        for t in threads:
+            t.join(timeout=30)
+    assert int(state.train.step) == N_TRAIN * trainer.config.learner_steps
+
+    # --- leg 1: ONE scrape carries every actor's labelled series --------
+    ex = obs.MetricsExporter(obs.get_registry(), port=0, mirror=mirror)
+    try:
+        text = (
+            urllib.request.urlopen(f"http://127.0.0.1:{ex.port}/metrics")
+            .read()
+            .decode()
+        )
+    finally:
+        ex.stop()
+    for a in ("0", "1"):
+        assert f'r2d2dpg_actor_phases_total{{actor="{a}"' in text, a
+        assert f'r2d2dpg_actor_param_version{{actor="{a}"' in text, a
+        assert (
+            f'r2d2dpg_fleet_telem_staleness_seconds{{actor="{a}"}}' in text
+        ), a
+    # One TYPE line per family even with two actors folded in.
+    assert text.count("# TYPE r2d2dpg_actor_phases_total") == 1
+    # The per-hop histograms are scrapeable alongside.
+    for hop in HOPS:
+        assert f"r2d2dpg_trace_{hop}_seconds" in text, hop
+
+    # --- leg 2: sampled spans cover all hops and add up -----------------
+    spans = fr.spans()
+    by_id = {}
+    for s in spans:
+        by_id.setdefault(s["trace_id"], {})[s["hop"]] = s
+    complete = [
+        tid for tid, hops in by_id.items() if set(HOPS) <= set(hops)
+    ]
+    assert complete, f"no complete trace; hops seen: {by_id and set().union(*[set(h) for h in by_id.values()])}"
+    # All-or-nothing recording: absorb-phase/shed batches contribute NO
+    # partial chain, so every recorded trace id carries all 8 hops and
+    # every hop histogram shares one sample population.
+    assert all(set(hops) == set(HOPS) for hops in by_id.values()), {
+        tid: sorted(hops) for tid, hops in by_id.items()
+        if set(hops) != set(HOPS)
+    }
+    # The hops are contiguous intervals, so per-hop durations must sum to
+    # the observed end-to-end latency of that batch (~10%: the learner-wait
+    # budget is attributable).
+    for tid in complete[:3]:
+        hops = by_id[tid]
+        total = sum(s["dur_s"] for s in hops.values())
+        t0 = min(s["t_wall"] for s in hops.values())
+        t1 = max(s["t_wall"] + s["dur_s"] for s in hops.values())
+        e2e = t1 - t0
+        assert e2e > 0
+        assert abs(total - e2e) <= 0.10 * e2e + 1e-3, (total, e2e)
+        # Both ends attributed: every span of this trace names its actor.
+        assert all(s.get("actor") in ("0", "1") for s in hops.values())
+
+    # --- trace.json: Perfetto/chrome://tracing-loadable artifact --------
+    path = str(tmp_path / "trace.json")
+    assert fr.dump_trace(path) == path
+    doc = json.loads(open(path).read())
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert set(HOPS) <= names
+    assert all(
+        e["ph"] == "X" and "ts" in e and "dur" in e and "pid" in e
+        for e in doc["traceEvents"]
+    )
+    mirror.clear()
+    fr.clear_spans()
