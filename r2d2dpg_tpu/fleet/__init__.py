@@ -19,12 +19,22 @@ tests/test_fleet.py.
   ``FleetLearner`` (the queue's single consumer: drain -> add -> learn).
 - ``supervisor`` — spawn/monitor/restart-with-backoff for the actor
   subprocesses; crashes land in the flight recorder.
+- ``chaos``      — seeded fault-injection drills at the fleet's real
+  boundaries (SIGKILL / stall / byte flip / socket close), each asserting
+  its documented recovery (ISSUE 7).
 
 See docs/FLEET.md for the wire protocol, backpressure/shed contract,
-noise-ladder mapping, and determinism anchor.
+noise-ladder mapping, determinism anchor, and the failure-modes matrix.
 """
 
-from r2d2dpg_tpu.fleet.ingest import FleetConfig, FleetLearner, IngestServer
+from r2d2dpg_tpu.fleet.chaos import ChaosEngine, Fault, parse_chaos_spec
+from r2d2dpg_tpu.fleet.ingest import (
+    FleetConfig,
+    FleetLearner,
+    IngestServer,
+    load_fleet_counters,
+    save_fleet_counters,
+)
 from r2d2dpg_tpu.fleet.supervisor import (
     ActorSupervisor,
     SupervisorConfig,
@@ -34,10 +44,15 @@ from r2d2dpg_tpu.fleet.wire import WireConfig
 
 __all__ = [
     "ActorSupervisor",
+    "ChaosEngine",
+    "Fault",
     "FleetConfig",
     "FleetLearner",
     "IngestServer",
     "SupervisorConfig",
     "WireConfig",
     "default_actor_argv",
+    "load_fleet_counters",
+    "parse_chaos_spec",
+    "save_fleet_counters",
 ]

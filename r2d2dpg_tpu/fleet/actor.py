@@ -37,10 +37,12 @@ import jax
 import jax.numpy as jnp
 
 from r2d2dpg_tpu.configs import CONFIGS, ExperimentConfig, get_config
+from r2d2dpg_tpu.fleet import chaos as fleet_chaos
 from r2d2dpg_tpu.fleet import wire
 from r2d2dpg_tpu.fleet.transport import (
     HEADER_BYTES,
     MAX_FRAME_BYTES,
+    READ_DEADLINE_S,
     K_ACK,
     K_BYE,
     K_HELLO,
@@ -48,9 +50,12 @@ from r2d2dpg_tpu.fleet.transport import (
     K_SEQS,
     K_TELEM,
     FrameError,
+    PeerDeadError,
     connect,
+    hello_auth_proof,
+    pack_hello,
     pack_obj,
-    recv_frame,
+    recv_frame_heartbeat,
     send_frame,
     send_frame_parts,
     unpack_obj,
@@ -63,7 +68,9 @@ from r2d2dpg_tpu.training.assembler import emit
 from r2d2dpg_tpu.training.pipeline import CollectorState, split_state
 from r2d2dpg_tpu.training.trainer import Trainer, TrainerConfig
 from r2d2dpg_tpu.utils.codes import (
+    EXIT_AUTH_REFUSED,
     EXIT_WIRE_REFUSED,
+    REFUSED_AUTH,
     REFUSED_WIRE,
     SHED_INGEST,
 )
@@ -137,9 +144,38 @@ class FleetActor:
         max_frame_bytes: int = MAX_FRAME_BYTES,
         telem_every: float = 0.0,
         trace_sample: float = 0.0,
+        read_deadline_s: float = READ_DEADLINE_S,
+        warmup_deadline_s: float = 120.0,
+        auth_token: Optional[str] = None,
+        chaos_spec: Optional[str] = None,
+        reconnect_tries: int = 4,
+        reconnect_base_s: float = 0.5,
+        reconnect_max_s: float = 10.0,
     ):
         self.actor_id = actor_id
         self.address = address
+        # Liveness bound on this end of the wire (transport.py): no ack
+        # wait or backpressured send ever hangs past the deadline; a
+        # silent learner is PINGed once, then treated as dead (reconnect
+        # attempts below, then a retryable exit for the supervisor).
+        # Until a session's FIRST ack the LARGER of the two deadlines
+        # applies — the learner's first drain-learn compile legitimately
+        # parks the handler in a queue-full wait (not reading, so no PONG
+        # either), and a dialed-down heartbeat must not read that warmup
+        # as a dead learner and churn the whole fleet through restarts.
+        # The ingest server holds the mirror-image warmup window.
+        self.read_deadline_s = read_deadline_s
+        self.warmup_deadline_s = max(warmup_deadline_s, read_deadline_s)
+        self.auth_token = auth_token
+        # Reconnect-with-backoff (docs/FLEET.md "Failure modes"): a torn
+        # connection — ingest restart, reaped stall, dropped conn — is
+        # retried in-process with a fresh socket + HELLO + param snapshot
+        # before the actor gives the incarnation up to the supervisor.  A
+        # session that delivered at least one acked batch resets the
+        # ladder (the same healthy-uptime contract as the supervisor's).
+        self.reconnect_tries = reconnect_tries
+        self.reconnect_base_s = reconnect_base_s
+        self.reconnect_max_s = reconnect_max_s
         # Fleet observability plane (ISSUE 6): TELEM snapshot cadence in
         # seconds (0 = off; train.py --obs-fleet spawns actors at 1 Hz)
         # and the experience-path trace sampling rate (0 = off).
@@ -176,7 +212,32 @@ class FleetActor:
         self._param_version = 0
         self._sheds = 0
         self._phase = 0
+        self._batches = 0  # emitted (post-warmup) batches: the chaos clock
         self._last_env_steps = 0.0  # for per-phase deltas (see run)
+        # At-least-once stats accounting: the per-phase episode/step
+        # DELTAS ride the SEQS message and are cleared only once an ack
+        # proves the server owns them (OK folds them; SHED banks them
+        # server-side).  A connection lost before the ack re-banks them
+        # into the NEXT send, so a drill's dropped frame loses experience
+        # (droppable by contract) but never loses accounting.  The rare
+        # double-count window — server queued the batch but its OK ack
+        # died on the wire — is the price of never silently losing steps.
+        self._pending_stats = {
+            "env_steps_delta": 0.0, "ep_return_sum": 0.0, "ep_count": 0.0,
+        }
+        # Actor-side chaos faults (fleet/chaos.py): the forwarded
+        # --chaos-spec's stall/corrupt drills that target THIS actor.
+        self.chaos: Optional[fleet_chaos.ActorChaos] = None
+        if chaos_spec:
+            # ``seed`` is already resolved above (config default or
+            # override) — the same value the learner's engine hashes, so
+            # both sides agree on every fault's target actor.
+            self.chaos = fleet_chaos.ActorChaos(
+                fleet_chaos.parse_chaos_spec(chaos_spec),
+                seed=seed,
+                num_actors=num_actors,
+                actor_id=actor_id,
+            )
         self._warm_prog = jax.jit(
             lambda cs, behavior, critic: t._collect(
                 cs, behavior=behavior, critic_params=critic
@@ -211,6 +272,12 @@ class FleetActor:
             "r2d2dpg_actor_telem_sent_total",
             "TELEM registry snapshots pushed to the learner's ingest",
         )
+        self._obs_reconnects = reg.counter(
+            "r2d2dpg_actor_reconnects_total",
+            "successful in-process reconnects after a torn connection "
+            "(fresh socket + HELLO + param snapshot, same incarnation)",
+        )
+        self._session_delivered = False
 
     # ---------------------------------------------------------- device parts
     def _collect_emit(self, cstate: CollectorState, behavior, critic):
@@ -284,9 +351,68 @@ class FleetActor:
 
     # ------------------------------------------------------------------ run
     def run(self, max_phases: Optional[int] = None) -> None:
-        """Stream until the server goes away (orderly end) or a protocol
-        error surfaces (crash — nonzero exit, the supervisor restarts)."""
-        sock = connect(self.address)
+        """Stream until the server goes away (orderly end) or an
+        unrecoverable error surfaces (crash — nonzero exit, the supervisor
+        restarts).
+
+        A torn connection — ingest restart, a heartbeat reap after a
+        stall, a chaos conn-drop — is retried IN-process first: fresh
+        socket, fresh HELLO (the server re-pushes its current param
+        snapshot ahead of the hello ack), fresh wire schema cache, with
+        exponential backoff between attempts.  Collection state (window,
+        env pool, phase count, pending accounting deltas) survives the
+        reconnect, so a recovered actor resumes streaming where it left
+        off instead of re-paying its warm-up.  Only after
+        ``reconnect_tries`` consecutive failed sessions does the error
+        propagate (nonzero exit; the supervisor's backoff restart takes
+        over)."""
+        attempts = 0
+        backoff = self.reconnect_base_s
+        while True:
+            self._session_delivered = False
+            try:
+                self._run_session(max_phases, reconnected=attempts > 0)
+                return
+            except (_OrderlyShutdown, _WireRefused, _AuthRefused):
+                raise  # deterministic verdicts: never retried here
+            except (FrameError, OSError) as e:
+                if isinstance(e, PeerDeadError):
+                    # Mirror of the ingest handler's reap: the learner
+                    # answered neither frames nor our PING.
+                    flight_event(
+                        "peer_dead",
+                        phase=self._phase,
+                        deadline_s=self.read_deadline_s,
+                        error=str(e),
+                    )
+                if self._session_delivered:
+                    # A healthy session resets the ladder (the supervisor's
+                    # healthy-uptime contract): only CONSECUTIVE failures
+                    # walk toward giving the incarnation up.
+                    attempts = 0
+                    backoff = self.reconnect_base_s
+                attempts += 1
+                if attempts > self.reconnect_tries:
+                    raise
+                err = f"{type(e).__name__}: {e}"
+                flight_event(
+                    "actor_reconnect_wait",
+                    phase=self._phase,
+                    attempt=attempts,
+                    backoff_s=round(backoff, 3),
+                    error=err,
+                )
+                time.sleep(backoff)
+                backoff = min(backoff * 2, self.reconnect_max_s)
+
+    def _run_session(
+        self, max_phases: Optional[int], *, reconnected: bool = False
+    ) -> None:
+        """One connection's lifetime: HELLO -> stream -> BYE."""
+        # Warmup window until the first SEQS ack (see __init__): the
+        # learner's first compile parks its handler, which is legitimate
+        # silence — the steady-state deadline arms after the ack.
+        sock = connect(self.address, read_deadline_s=self.warmup_deadline_s)
         # Wire state lives and dies with the socket: a reconnect gets a
         # fresh packer whose first SEQS frame re-inlines its schema.
         packer = wire.TreePacker(
@@ -296,17 +422,18 @@ class FleetActor:
             max_frame_bytes=self.max_frame_bytes
         )
         try:
+            hello = {
+                "actor_id": self.actor_id,
+                "num_envs": self.trainer.config.num_envs,
+                **wire.negotiation_fields(self.wire_config),
+            }
+            if self.auth_token is not None:
+                hello["auth"] = hello_auth_proof(self.auth_token)
             self._obs_bytes_out.inc(
                 send_frame(
                     sock,
                     K_HELLO,
-                    pack_obj(  # wire-lint: control
-                        {
-                            "actor_id": self.actor_id,
-                            "num_envs": self.trainer.config.num_envs,
-                            **wire.negotiation_fields(self.wire_config),
-                        }
-                    ),
+                    pack_hello(hello),  # JSON: parsed pre-auth on the far end
                     max_frame_bytes=self.max_frame_bytes,
                 )
             )
@@ -318,8 +445,21 @@ class FleetActor:
                     f"the learner's --fleet-wire/--fleet-compress "
                     f"(server expects {hello_ack.get('expect')})"
                 )
+            if hello_ack.get("code") == REFUSED_AUTH:
+                raise _AuthRefused(
+                    "ingest refused HELLO authentication; launch this "
+                    "actor with the learner's --fleet-token"
+                )
+            if reconnected:
+                flight_event("actor_reconnect", phase=self._phase)
+                self._obs_reconnects.inc()
             self._maybe_send_telem(sock, force=True)
             while max_phases is None or self._phase < max_phases:
+                if self.chaos is not None:
+                    # The stall drill: stop reading AND sending mid-loop,
+                    # exactly what a wedged env or GC pause looks like on
+                    # the wire — the ingest handler's heartbeat reaps us.
+                    self.chaos.maybe_stall(self._batches + 1)
                 # Trace sampling decided at collection time (obs/trace.py):
                 # rate 0 allocates nothing and the frame is byte-identical
                 # to an untraced wire.
@@ -333,6 +473,7 @@ class FleetActor:
                     # supervised restart.
                     self._maybe_send_telem(sock)
                     continue
+                self._batches += 1
                 # ONE batched device fetch per phase (episode stats + the
                 # staged pytree + priorities) — the pop_episode_metrics
                 # lesson; separate fetches would be three host syncs on
@@ -354,8 +495,14 @@ class FleetActor:
                 # DELTAS, not cumulative: a supervised restart resets this
                 # process, and the learner's fleet-wide sums must stay
                 # monotone across incarnations (ingest just accumulates).
+                # Folded into _pending_stats, which is cleared only on an
+                # ack — a frame lost to a torn connection re-banks its
+                # accounting into the next send (at-least-once; __init__).
                 steps_delta = float(env_steps) - self._last_env_steps
                 self._last_env_steps = float(env_steps)
+                self._pending_stats["env_steps_delta"] += steps_delta
+                self._pending_stats["ep_return_sum"] += float(ret_sum)
+                self._pending_stats["ep_count"] += float(count)
                 # The steady-state hot path: schema-cached binary frames
                 # (fleet/wire.py), tensor bytes streamed without an
                 # intermediate payload join (send_frame_parts).
@@ -363,24 +510,42 @@ class FleetActor:
                     {
                         "phase": self._phase,
                         "param_version": self._param_version,
-                        "env_steps_delta": steps_delta,
-                        "ep_return_sum": float(ret_sum),
-                        "ep_count": float(count),
+                        **self._pending_stats,
                         "staged": StagedSequences(
                             seq=seq_host, priorities=prios_host
                         ),
                     },
                     trace=tr,
                 )
-                self._obs_bytes_out.inc(
-                    send_frame_parts(
-                        sock,
-                        K_SEQS,
-                        parts,
-                        max_frame_bytes=self.max_frame_bytes,
+                if self.chaos is not None and self.chaos.corrupt_next_frame(
+                    self._batches
+                ):
+                    # The corrupt-frame drill: pristine CRC over flipped
+                    # bytes — the server MUST reject it (FrameCRCError)
+                    # and kill the connection; we reconnect and re-bank.
+                    self._obs_bytes_out.inc(
+                        fleet_chaos.send_corrupt_frame(sock, K_SEQS, parts)
                     )
-                )
+                else:
+                    self._obs_bytes_out.inc(
+                        send_frame_parts(
+                            sock,
+                            K_SEQS,
+                            parts,
+                            max_frame_bytes=self.max_frame_bytes,
+                        )
+                    )
                 ack = self._await_ack(sock)
+                # Acked (OK or shed): the server owns the accounting now —
+                # OK folds it with the batch, a shed banks it server-side.
+                for k in self._pending_stats:
+                    self._pending_stats[k] = 0.0
+                if not self._session_delivered:
+                    # First ack of the session: warmup is over, arm the
+                    # steady-state heartbeat deadline (mirror of the
+                    # ingest handler tightening on its first SEQS).
+                    sock.settimeout(self.read_deadline_s)
+                self._session_delivered = True
                 if ack["code"] == SHED_INGEST:
                     self._sheds += 1
                     self._obs_shed.inc()
@@ -428,10 +593,17 @@ class FleetActor:
     def _await_ack(self, sock) -> Any:
         """Read to the next ACK, applying any PARAMS pushed ahead of it
         (the server orders PARAMS-then-ACK so a fresh snapshot is live
-        before the next collect phase)."""
+        before the next collect phase).
+
+        Deadline-aware (transport.recv_frame_heartbeat): a learner silent
+        past the read deadline is PINGed once and declared dead on a
+        second silence — this wait was the fleet's last unbounded read."""
         while True:
-            kind, payload = recv_frame(
-                sock, max_frame_bytes=self.max_frame_bytes
+            kind, payload = recv_frame_heartbeat(
+                sock,
+                max_frame_bytes=self.max_frame_bytes,
+                bytes_in=self._obs_bytes_in.inc,
+                bytes_out=self._obs_bytes_out.inc,
             )
             self._obs_bytes_in.inc(HEADER_BYTES + len(payload))
             if kind == K_PARAMS:
@@ -454,6 +626,12 @@ class _WireRefused(FrameError):
     Exits with ``EXIT_WIRE_REFUSED`` so the supervisor gives the slot up
     instead of crash-restarting a misconfigured actor forever (every
     incarnation would be refused again within milliseconds)."""
+
+
+class _AuthRefused(FrameError):
+    """HELLO refused on the --fleet-token proof: deterministic
+    misconfiguration, same terminal contract as ``_WireRefused`` (exits
+    ``EXIT_AUTH_REFUSED``; the supervisor gives the slot up)."""
 
 
 # ---------------------------------------------------------------------- CLI
@@ -517,6 +695,20 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--trace-sample", type=float, default=0.0,
                    help="experience-path trace sampling rate in [0, 1] "
                    "(0 = off: no trace sidecar, byte-identical wire)")
+    # Fault tolerance (ISSUE 7; docs/FLEET.md "Failure modes & recovery").
+    p.add_argument("--read-deadline", type=float, default=READ_DEADLINE_S,
+                   help="seconds a blocking wire read may wait before the "
+                   "PING-then-reap liveness protocol runs — must mirror "
+                   "the learner's --fleet-heartbeat (the spawner forwards "
+                   "it)")
+    p.add_argument("--fleet-token", default=None,
+                   help="shared HELLO-authentication secret; defaults to "
+                   "$R2D2DPG_FLEET_TOKEN (the spawner passes the secret "
+                   "via the environment so it never shows in ps)")
+    p.add_argument("--chaos-spec", default=None,
+                   help="seeded chaos schedule (fleet/chaos.py grammar); "
+                   "this actor fires the stall/corrupt faults that target "
+                   "its id (the learner's engine fires the rest)")
     return p.parse_args(argv)
 
 
@@ -548,11 +740,22 @@ def main(argv=None) -> None:
     args = parse_args(argv)
     set_flight_identity(actor=args.actor_id)
     if args.flight_path:
+        import os
         import signal
 
         from r2d2dpg_tpu.obs import get_flight_recorder
 
-        get_flight_recorder().install(args.flight_path)
+        flight_path = args.flight_path
+        if os.path.exists(flight_path):
+            # A predecessor incarnation (supervised restart) already
+            # dumped here — its ring is post-mortem EVIDENCE (possibly a
+            # chaos injection flushed moments before its SIGKILL), and an
+            # overwrite would destroy it.  Dump beside it instead; the
+            # fleet timeline merge globs flight*.jsonl, so both
+            # incarnations stay attributable.
+            root, ext = os.path.splitext(flight_path)
+            flight_path = f"{root}.pid{os.getpid()}{ext}"
+        get_flight_recorder().install(flight_path)
         # The supervisor's orderly teardown is a SIGTERM, whose default
         # disposition skips atexit — and with it the flight dump this
         # flag just armed.  Convert it to a clean SystemExit so every
@@ -570,35 +773,53 @@ def main(argv=None) -> None:
         raise SystemExit(
             f"fleet actor {args.actor_id}: --trace-sample must be in [0, 1]"
         )
-    actor = FleetActor(
-        exp,
-        actor_id=args.actor_id,
-        num_actors=args.num_actors,
-        address=args.connect,
-        seed=args.seed,
-        wire_config=wire_config,
-        max_frame_bytes=args.max_frame_bytes,
-        telem_every=args.telem_every,
-        trace_sample=args.trace_sample,
-    )
+    auth_token = args.fleet_token
+    if auth_token is None:
+        import os
+
+        auth_token = os.environ.get("R2D2DPG_FLEET_TOKEN") or None
+    try:
+        actor = FleetActor(
+            exp,
+            actor_id=args.actor_id,
+            num_actors=args.num_actors,
+            address=args.connect,
+            seed=args.seed,
+            wire_config=wire_config,
+            max_frame_bytes=args.max_frame_bytes,
+            telem_every=args.telem_every,
+            trace_sample=args.trace_sample,
+            read_deadline_s=args.read_deadline,
+            auth_token=auth_token,
+            chaos_spec=args.chaos_spec,
+        )
+    except ValueError as e:
+        # e.g. a malformed --chaos-spec: deterministic misconfiguration,
+        # refused at startup rather than as a crash-looping fleet.
+        raise SystemExit(f"fleet actor {args.actor_id}: {e}")
     flight_event("actor_start", phase=0, address=args.connect)
     try:
         actor.run(max_phases=args.phases)
     except _OrderlyShutdown:
         # The server said BYE: the learner is done — exit 0, nothing broke.
         flight_event("actor_disconnect", phase=actor._phase)
-    except _WireRefused as e:
+    except (_WireRefused, _AuthRefused) as e:
         # Deterministic misconfiguration — a restart would be refused
         # again within milliseconds.  Exit with the dedicated code so the
         # supervisor gives this slot up instead of crash-looping it.
         err = f"{type(e).__name__}: {e}"
-        flight_event("actor_wire_refused", phase=actor._phase, error=err)
+        auth = isinstance(e, _AuthRefused)
+        flight_event(
+            "actor_auth_refused" if auth else "actor_wire_refused",
+            phase=actor._phase,
+            error=err,
+        )
         print(  # obs-lint: allow — CLI entrypoint, routed to the actor log
             f"fleet actor {args.actor_id}: {err}",
             file=sys.stderr,
             flush=True,
         )
-        raise SystemExit(EXIT_WIRE_REFUSED)
+        raise SystemExit(EXIT_AUTH_REFUSED if auth else EXIT_WIRE_REFUSED)
     except (FrameError, OSError) as e:
         # Anything else — refused connect, CRC violation, torn stream — is
         # a CRASH per this module's contract: record the actual error

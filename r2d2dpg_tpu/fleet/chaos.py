@@ -1,0 +1,359 @@
+"""Chaos-injection drills: seeded faults at the fleet's real boundaries.
+
+A fault-tolerance layer is only real if its recovery paths are exercised —
+Ape-X-scale runs (PAPERS.md 1803.00933) and Podracer-style long-lived TPU
+jobs (2104.06272) treat peer death, wedged processes and wire corruption
+as table stakes, not exceptions.  This module injects exactly those
+faults, on a deterministic seeded schedule, at the boundaries where they
+happen for real:
+
+===================  =============================  ========================
+fault                injection boundary             documented recovery
+===================  =============================  ========================
+``kill_actor``       supervisor SIGKILL             backoff restart
+                     (``ActorSupervisor.            (``actor_crash`` ->
+                     kill_actor``)                  ``actor_restart``)
+``stall_actor``      actor-side ``time.sleep``      heartbeat reap
+                     mid-collect                    (``peer_dead``) + actor
+                                                    reconnect
+``corrupt_frame``    wire-level byte flip with the  CRC reject kills the
+                     pristine CRC kept in the       connection
+                     header (``send_corrupt_        (``ingest_conn_error``)
+                     frame``)                       + actor reconnect
+``kill_ingest_conn`` learner-side socket close      actor reconnect-with-
+                     (``IngestServer.               backoff + fresh HELLO/
+                     drop_connection``)             param snapshot
+===================  =============================  ========================
+
+**Spec grammar** (``--chaos-spec``)::
+
+    spec  := fault ("," fault)*
+    fault := kind "@p" phase [":" seconds "s"]
+    e.g.    kill_actor@p3,stall_actor@p5:4s,corrupt_frame@p7,kill_ingest_conn@p9
+
+``phase`` is 1-based on the *injecting* side: learner-side faults count
+drain-learn phases, actor-side faults count the target actor's emitted
+batches.  The duration suffix is only meaningful for ``stall_actor``.
+
+**Determinism**: which actor a fault targets is derived from
+``(seed, fault index, fault kind)`` by ``fault_target`` — a pure hash both
+sides compute identically, so the learner-side engine and every actor
+subprocess (the spawner forwards ``--chaos-spec`` verbatim) agree on the
+schedule without coordination.  Same seed, same spec, same drill.
+
+Every injection lands in the flight recorder (``chaos_inject`` with
+``fault=``/``phase=``/``actor=``) and bumps
+``r2d2dpg_fleet_chaos_drills_total{fault=...}``; the recovery events are
+the subsystems' existing ones, so ``flight.jsonl`` (or a fleet-wide
+``obs.flight merge``) pairs every injected fault with its recovery
+(docs/FLEET.md "Failure modes & recovery").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import hashlib
+import json
+import os
+import re
+import socket
+import time
+import zlib
+from typing import Optional, Sequence, Tuple
+
+from r2d2dpg_tpu.fleet import transport
+from r2d2dpg_tpu.obs import flight_event, get_flight_recorder, get_registry
+
+# Faults injected from the learner process (its drain-phase clock) vs from
+# inside the target actor process (its emitted-batch clock).
+LEARNER_FAULTS = frozenset({"kill_actor", "kill_ingest_conn"})
+ACTOR_FAULTS = frozenset({"stall_actor", "corrupt_frame"})
+FAULT_KINDS = tuple(sorted(LEARNER_FAULTS | ACTOR_FAULTS))
+
+_FAULT_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)@p(?P<phase>\d+)(?::(?P<dur>\d+(?:\.\d+)?)s)?$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scheduled injection (parsed from the ``--chaos-spec`` grammar)."""
+
+    kind: str
+    phase: int  # 1-based, on the injecting side's phase clock
+    duration_s: float = 0.0  # stall_actor only
+    index: int = 0  # position in the spec: part of the target derivation
+
+
+def parse_chaos_spec(spec: str) -> Tuple[Fault, ...]:
+    """``"kill_actor@p3,stall_actor@p5:4s"`` -> ``(Fault, ...)``.
+
+    Raises ``ValueError`` with the offending token on any malformed entry
+    — a chaos schedule that silently dropped a fault would let a broken
+    recovery path pass its drill."""
+    faults = []
+    for i, token in enumerate(t.strip() for t in spec.split(",")):
+        if not token:
+            raise ValueError(f"empty fault token in chaos spec {spec!r}")
+        m = _FAULT_RE.match(token)
+        if m is None:
+            raise ValueError(
+                f"malformed chaos fault {token!r} (grammar: "
+                f"kind@pN[:Ds], e.g. stall_actor@p5:4s)"
+            )
+        kind = m.group("kind")
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown chaos fault {kind!r}; have {sorted(FAULT_KINDS)}"
+            )
+        phase = int(m.group("phase"))
+        if phase < 1:
+            raise ValueError(f"chaos fault {token!r}: phase must be >= 1")
+        dur = float(m.group("dur") or 0.0)
+        if dur and kind != "stall_actor":
+            raise ValueError(
+                f"chaos fault {token!r}: only stall_actor takes a duration"
+            )
+        if kind == "stall_actor" and dur <= 0.0:
+            raise ValueError(
+                f"chaos fault {token!r}: stall_actor needs a duration "
+                f"(e.g. stall_actor@p5:4s)"
+            )
+        faults.append(Fault(kind=kind, phase=phase, duration_s=dur, index=i))
+    return tuple(faults)
+
+
+def fault_target(fault: Fault, seed: int, num_actors: int) -> int:
+    """Which actor id a fault hits: a pure seeded hash every process
+    computes identically (no RNG state, no coordination — the learner
+    engine and each forwarded-spec actor agree by construction)."""
+    digest = hashlib.sha256(
+        f"{seed}:{fault.index}:{fault.kind}".encode()
+    ).digest()
+    return int.from_bytes(digest[:8], "big") % max(num_actors, 1)
+
+
+def _drill_counter():
+    return get_registry().counter(
+        "r2d2dpg_fleet_chaos_drills_total",
+        "chaos faults injected (one per scheduled drill that fired)",
+        labelnames=("fault",),
+    )
+
+
+def record_injection(fault: Fault, actor: int, **extra) -> None:
+    """The one way an injection becomes visible: ``chaos_inject`` flight
+    event + the per-fault drill counter — shared by both sides so every
+    fault is attributable in ``flight.jsonl``/``obs.flight merge``."""
+    flight_event(
+        "chaos_inject",
+        fault=fault.kind,
+        phase=fault.phase,
+        actor=actor,
+        **extra,
+    )
+    _drill_counter().labels(fault=fault.kind).inc()
+    # Flush the ring NOW (atomic; no-op when no dump path is installed):
+    # several drills end in a SIGKILL — the injected fault's own, or a
+    # teardown kill of a process whose SIGTERM is deferred behind a
+    # compile — and a record that only lives in the in-memory ring dies
+    # with it.  Durable-at-injection is what makes every fault
+    # attributable in flight.jsonl no matter how the drill ends.
+    get_flight_recorder().dump()
+
+
+# ---------------------------------------------------------------- injectors
+def send_corrupt_frame(
+    sock: socket.socket, kind: int, parts: Sequence, *, flip_at: Optional[int] = None
+) -> int:
+    """The ``corrupt_frame`` boundary: one payload byte is flipped AFTER
+    the header CRC is computed over the pristine bytes — exactly what
+    bit-rot or a torn write produces on a real wire — so the receiver's
+    CRC check must reject the frame (``FrameCRCError`` kills the
+    connection; transport.py's rule).  Returns bytes sent."""
+    payload = b"".join(bytes(p) for p in parts)
+    if not payload:
+        raise ValueError("cannot corrupt an empty payload")
+    crc = zlib.crc32(payload)  # pristine: the header promises these bytes
+    i = len(payload) // 2 if flip_at is None else flip_at % len(payload)
+    corrupted = payload[:i] + bytes([payload[i] ^ 0xFF]) + payload[i + 1:]
+    header = transport._HEADER.pack(
+        transport.MAGIC, kind, len(payload), crc
+    )
+    sock.sendall(header)
+    sock.sendall(corrupted)
+    return transport.HEADER_BYTES + len(payload)
+
+
+class ChaosEngine:
+    """Learner-side scheduler: fires learner-boundary faults on the drain
+    clock (``FleetLearner.run``'s ``phase_fn`` hook).
+
+    Actor-boundary faults in the spec are NOT fired here — each actor
+    fires its own from the forwarded spec (``ActorChaos``) — but the
+    engine knows the whole schedule, so ``unfired()`` at end of run names
+    any learner-side drill that never got its phase."""
+
+    def __init__(
+        self,
+        faults: Sequence[Fault],
+        *,
+        seed: int,
+        num_actors: int,
+        supervisor=None,
+        server=None,
+    ):
+        self.faults = tuple(faults)
+        self.seed = seed
+        self.num_actors = num_actors
+        self.supervisor = supervisor
+        self.server = server
+        self._fired = set()
+        _drill_counter()  # register the family before any drill fires
+
+    def on_phase(self, phase: int) -> None:
+        """Fire every due learner-side fault (``phase`` is the drain-learn
+        count, 1-based).  ``>=`` rather than ``==``: a resumed run whose
+        checkpoint already passed a fault's phase fires it immediately
+        rather than silently never.
+
+        A fault is marked fired — and recorded — only when its injection
+        actually LANDED (a kill delivered, a live connection dropped).  A
+        no-op attempt (target already a corpse mid-backoff, no live
+        connection) stays pending: it retries next phase, and if it never
+        lands, ``unfired()`` reports it — recording a no-op would read as
+        a drill that passed without its fault ever being injected."""
+        for fault in self.faults:
+            if (
+                fault.kind not in LEARNER_FAULTS
+                or fault.index in self._fired
+                or phase < fault.phase
+            ):
+                continue
+            target = fault_target(fault, self.seed, self.num_actors)
+            if fault.kind == "kill_actor":
+                killed = (
+                    self.supervisor is not None
+                    and self.supervisor.kill_actor(target)
+                )
+                if not killed:
+                    continue
+                self._fired.add(fault.index)
+                record_injection(fault, target, at_phase=phase)
+            elif fault.kind == "kill_ingest_conn":
+                dropped = (
+                    self.server.drop_connection(actor=str(target))
+                    if self.server is not None
+                    else None
+                )
+                if dropped is None:
+                    continue
+                self._fired.add(fault.index)
+                record_injection(
+                    fault, target, at_phase=phase, dropped=dropped
+                )
+
+    def unfired(self) -> Tuple[Fault, ...]:
+        """Learner-side faults whose phase never arrived (run too short):
+        callers log these so a drill that never ran cannot read as one
+        that passed."""
+        return tuple(
+            f
+            for f in self.faults
+            if f.kind in LEARNER_FAULTS and f.index not in self._fired
+        )
+
+
+def actor_faults_unfired(
+    faults: Sequence[Fault], logdir: str, *, seed: int, num_actors: int
+) -> Tuple[Fault, ...]:
+    """Actor-boundary faults of a spec with NO injection evidence in the
+    ``flight_actor*.jsonl`` dumps under ``logdir``.
+
+    The learner-side engine cannot see an actor process fire (or fail to
+    fire) its drills; what it CAN see, after teardown has flushed every
+    incarnation's dump, is whether a ``chaos_inject`` line exists for each
+    scheduled actor-side fault — ``record_injection`` flushes at injection
+    time precisely so this evidence survives any way the drill ends.
+    Evidence is matched on (kind, phase, target actor) — ``seed`` and
+    ``num_actors`` recompute each fault's target — so duplicate spec
+    entries hashing to different actors each need their own line.
+    Callers warn on the returned faults: a drill that left no evidence
+    must not read as one that passed (the ``unfired()`` contract)."""
+    expected = [f for f in faults if f.kind in ACTOR_FAULTS]
+    if not expected:
+        return ()
+    seen = set()
+    for path in glob.glob(os.path.join(logdir, "flight_actor*.jsonl")):
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    try:
+                        e = json.loads(line)
+                    except ValueError:
+                        continue
+                    if e.get("kind") == "chaos_inject":
+                        seen.add(
+                            (e.get("fault"), e.get("phase"), e.get("actor"))
+                        )
+        except OSError:
+            continue
+    return tuple(
+        f
+        for f in expected
+        if (f.kind, f.phase, fault_target(f, seed, num_actors)) not in seen
+    )
+
+
+class ActorChaos:
+    """Actor-side scheduler: the faults of a forwarded spec that target
+    THIS actor, fired on its emitted-batch clock (``FleetActor.run``).
+
+    A supervised restart re-parses the same argv, so a restarted
+    incarnation re-arms its schedule — harmless for the drill semantics
+    (a stall is just slow; a corrupt frame re-drills the same recovery)
+    and exactly what a deterministic schedule means."""
+
+    def __init__(
+        self, faults: Sequence[Fault], *, seed: int, num_actors: int, actor_id: int
+    ):
+        self.actor_id = actor_id
+        self._mine = tuple(
+            f
+            for f in faults
+            if f.kind in ACTOR_FAULTS
+            and fault_target(f, seed, num_actors) == actor_id
+        )
+        self._fired = set()
+
+    def maybe_stall(self, batch_idx: int) -> float:
+        """Sleep out any due ``stall_actor`` fault (before collecting batch
+        ``batch_idx``); returns seconds slept.  The sleep IS the fault: the
+        actor stops reading and sending, so the ingest handler's heartbeat
+        deadline reaps it as ``peer_dead``."""
+        slept = 0.0
+        for f in self._due("stall_actor", batch_idx):
+            self._fired.add(f.index)
+            record_injection(f, self.actor_id, at_phase=batch_idx)
+            time.sleep(f.duration_s)
+            slept += f.duration_s
+        return slept
+
+    def corrupt_next_frame(self, batch_idx: int) -> bool:
+        """True when batch ``batch_idx``'s SEQS frame should go out through
+        ``send_corrupt_frame`` (fires each due corrupt fault once)."""
+        due = self._due("corrupt_frame", batch_idx)
+        for f in due:
+            self._fired.add(f.index)
+            record_injection(f, self.actor_id, at_phase=batch_idx)
+        return bool(due)
+
+    def _due(self, kind: str, batch_idx: int):
+        return [
+            f
+            for f in self._mine
+            if f.kind == kind
+            and f.index not in self._fired
+            and batch_idx >= f.phase
+        ]

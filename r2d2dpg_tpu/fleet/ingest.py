@@ -42,6 +42,9 @@ staging queue's single consumer — the single-writer contract
 from __future__ import annotations
 
 import dataclasses
+import hmac
+import json
+import os
 import queue
 import socket
 import threading
@@ -61,8 +64,10 @@ from r2d2dpg_tpu.fleet.transport import (
     K_SEQS,
     K_TELEM,
     FrameError,
+    PeerDeadError,
     pack_obj,
     recv_frame,
+    recv_frame_heartbeat,
     send_frame,
     to_host,
     unpack_obj,
@@ -78,7 +83,12 @@ from r2d2dpg_tpu.training.pipeline import (
     split_state,
 )
 from r2d2dpg_tpu.training.trainer import Trainer, TrainerState
-from r2d2dpg_tpu.utils.codes import OK, REFUSED_WIRE, SHED_INGEST
+from r2d2dpg_tpu.utils.codes import (
+    OK,
+    REFUSED_AUTH,
+    REFUSED_WIRE,
+    SHED_INGEST,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,6 +115,18 @@ class FleetConfig:
     # Max queued staged batches stacked into ONE compiled drain call (the
     # arena-add dispatch amortization); 1 = today's one-call-per-batch.
     drain_coalesce: int = 1
+    # Liveness (docs/FLEET.md "Failure modes"): per-connection read
+    # deadline in seconds — a peer silent past it is PINGed once and
+    # reaped on a second silence (transport.recv_frame_heartbeat).  The
+    # window between HELLO and the first SEQS frame uses the LARGER of
+    # this and ``warmup_deadline_s`` (a fresh actor legitimately goes
+    # silent for its collect-program compile).
+    heartbeat_s: float = transport.READ_DEADLINE_S
+    warmup_deadline_s: float = 120.0
+    # Shared-secret HELLO authentication (hmac.compare_digest); None = no
+    # auth.  REQUIRED before binding a routable (non-loopback) address on
+    # anything but a trusted network.
+    auth_token: Optional[str] = None
 
 
 class IngestServer:
@@ -119,6 +141,9 @@ class IngestServer:
         startup_shed_grace_s: float = 120.0,
         max_frame_bytes: int = transport.MAX_FRAME_BYTES,
         wire_config: Optional[wire.WireConfig] = None,
+        read_deadline_s: float = transport.READ_DEADLINE_S,
+        warmup_deadline_s: float = 120.0,
+        auth_token: Optional[str] = None,
     ):
         self.queue = staging_queue
         self._request_address = address
@@ -126,6 +151,14 @@ class IngestServer:
         self.startup_shed_grace_s = startup_shed_grace_s
         self.max_frame_bytes = max_frame_bytes
         self.wire_config = (wire_config or wire.WireConfig()).validate()
+        # Liveness: per-connection read deadline (the heartbeat bound).
+        # Between HELLO and the first SEQS the LARGER of the two applies —
+        # a fresh actor's collect compile is legitimate silence, and a
+        # spurious reap per actor startup would drown the real signal.
+        self.read_deadline_s = read_deadline_s
+        self.warmup_deadline_s = max(warmup_deadline_s, read_deadline_s)
+        self.auth_token = auth_token
+        self.stop_join_s = 5.0  # handler join bound before leak reporting
         # Param snapshots are packed once per version and broadcast to all
         # handlers, so every frame inlines its schema — a freshly
         # reconnected (restarted) actor must decode it standalone.
@@ -144,11 +177,15 @@ class IngestServer:
         self._steady = threading.Event()
         self._first_put_at: Optional[float] = None
         self.address: Optional[str] = None  # resolved at start()
+        # What actors should DIAL: equals ``address`` except for wildcard
+        # binds (0.0.0.0), where locally-spawned actors get loopback.
+        self.connect_address: Optional[str] = None
         self._unix_path: Optional[str] = None
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._handlers: List[threading.Thread] = []
         self._conns: Dict[int, socket.socket] = {}  # ident -> live socket
+        self._conn_actors: Dict[int, str] = {}  # ident -> actor id (HELLO'd)
         self._conn_seq = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -200,6 +237,12 @@ class IngestServer:
             "r2d2dpg_fleet_actors_connected", "live actor connections"
         )
         self._obs_connected.set_fn(lambda: float(len(self._conns)))
+        self._obs_peer_dead = reg.counter(
+            "r2d2dpg_fleet_peer_dead_total",
+            "connections reaped after a silent heartbeat deadline (the "
+            "peer answered neither frames nor the PING probe)",
+            labelnames=("actor",),
+        )
         self._obs_bytes_in = reg.counter(
             "r2d2dpg_fleet_bytes_in_total",
             "bytes received off the fleet wire (frames + headers)",
@@ -267,8 +310,14 @@ class IngestServer:
         if family == socket.AF_INET:
             host, port = sock.getsockname()[:2]
             self.address = f"{host}:{port}"
+            # A wildcard bind listens everywhere but is not DIALABLE as
+            # written; locally-spawned actors get loopback (remote actors
+            # are pointed at a routable interface by the operator).
+            dial_host = "127.0.0.1" if host in ("0.0.0.0", "::", "") else host
+            self.connect_address = f"{dial_host}:{port}"
         else:
             self.address = f"unix:{target}"
+            self.connect_address = self.address
             self._unix_path = target
         self._listener = sock
         self._accept_thread = threading.Thread(
@@ -321,7 +370,19 @@ class IngestServer:
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
         for t in list(self._handlers):
-            t.join(timeout=5)
+            t.join(timeout=self.stop_join_s)
+            if t.is_alive():
+                # A handler that outlives its join window is WEDGED (its
+                # socket is closed and _stop is set, so every legitimate
+                # path exits in a slice) — report it instead of silently
+                # leaking the thread, so a post-mortem sees the wedge.
+                print(  # obs-lint: allow — teardown diagnostic
+                    f"fleet ingest: handler thread {t.name} still alive "
+                    f"{self.stop_join_s:.0f}s after stop — leaked (wedged "
+                    f"handler; see flight.jsonl)",
+                    flush=True,
+                )
+                flight_event("ingest_handler_leaked", thread=t.name)
 
     def mark_steady(self) -> None:
         """Startup is over (the drain loop's first compiled drain-learn
@@ -412,6 +473,40 @@ class IngestServer:
                 self._shed_stats[k] = 0.0
         return out
 
+    def drop_connection(self, actor: Optional[str] = None) -> Optional[str]:
+        """Abruptly close one live actor connection — the ``kill_ingest_conn``
+        chaos boundary (fleet/chaos.py), equivalent to a mid-run network
+        reset.  ``actor`` picks by HELLO'd id; ``None`` (or an id with no
+        live connection) drops the oldest live connection instead, so a
+        scheduled drill always drills SOMETHING when any peer is up.
+        Returns the dropped actor id (or ``None`` when no connection is
+        live).  The handler sees its blocking read fail and walks the
+        normal torn-stream path; the actor reconnects with backoff."""
+        with self._lock:
+            ident = None
+            if actor is not None:
+                for i, a in self._conn_actors.items():
+                    if a == str(actor) and i in self._conns:
+                        ident = i
+                        break
+            if ident is None and self._conns:
+                ident = next(iter(self._conns))
+            if ident is None:
+                return None
+            conn = self._conns[ident]
+            dropped = self._conn_actors.get(ident, "?")
+        try:
+            # SHUT_RDWR first: close() alone does not wake a handler whose
+            # recv holds a reference to the open file description.
+            conn.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            conn.close()
+        except OSError:
+            pass
+        return dropped
+
     # ------------------------------------------------------------ connection
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -427,6 +522,10 @@ class IngestServer:
                     pass
                 return
             transport.configure_socket(conn)
+            # Warmup deadline until the first SEQS frame: a fresh actor's
+            # collect compile is legitimate silence; the handler tightens
+            # to read_deadline_s once the connection is streaming.
+            conn.settimeout(self.warmup_deadline_s)
             with self._lock:
                 self._conn_seq += 1
                 ident = self._conn_seq
@@ -513,8 +612,36 @@ class IngestServer:
             )
             if kind != K_HELLO:
                 raise FrameError(f"expected HELLO, got kind {kind}")
-            hello = unpack_obj(payload)  # wire-lint: control
+            # JSON, never pickle: this parse runs BEFORE the auth check
+            # below (the proof is inside the payload), on bytes from a
+            # peer nothing has vouched for — transport.pack_hello.
+            hello = transport.unpack_hello(payload)
             actor = str(hello.get("actor_id", "?"))
+            if self.auth_token is not None:
+                # Constant-time comparison of the HELLO proof against the
+                # shared secret's (ROADMAP cross-host prerequisite): a
+                # mismatch — or a missing proof — is refused at the door,
+                # before wire negotiation or any tensor decode.  Also
+                # before ANY per-actor state: the claimed actor_id is
+                # attacker-controlled on exactly the routable binds auth
+                # exists for, and registering labeled metric series or a
+                # _conn_actors entry per unauthenticated HELLO would let a
+                # port scanner grow the registry (and the /metrics page)
+                # without bound.  The bounded flight ring may name it.
+                want = transport.hello_auth_proof(self.auth_token)
+                got = str(hello.get("auth", ""))
+                if not hmac.compare_digest(want, got):
+                    flight_event("auth_refused", actor=actor)
+                    send_frame(
+                        conn,
+                        K_ACK,
+                        pack_obj(  # wire-lint: control
+                            {"code": REFUSED_AUTH, "param_version": 0}
+                        ),
+                    )
+                    return
+            with self._lock:
+                self._conn_actors[ident] = actor
             bytes_in = self._obs_bytes_in.labels(actor=actor)
             bytes_out = self._obs_bytes_out.labels(actor=actor)
             bytes_in.inc(HEADER_BYTES + len(payload))
@@ -553,9 +680,13 @@ class IngestServer:
                     ),
                 )
             )
+            streaming = False  # first SEQS tightens the read deadline
             while not self._stop.is_set():
-                kind, payload = recv_frame(
-                    conn, max_frame_bytes=self.max_frame_bytes
+                kind, payload = recv_frame_heartbeat(
+                    conn,
+                    max_frame_bytes=self.max_frame_bytes,
+                    bytes_in=bytes_in.inc,
+                    bytes_out=bytes_out.inc,
                 )
                 t_recv = time.time()
                 bytes_in.inc(HEADER_BYTES + len(payload))
@@ -578,6 +709,12 @@ class IngestServer:
                     continue
                 if kind != K_SEQS:
                     raise FrameError(f"expected SEQS/BYE, got kind {kind}")
+                if not streaming:
+                    # The connection is streaming: from here on the peer's
+                    # longest legitimate silence is one collect phase, and
+                    # the heartbeat deadline bounds it.
+                    conn.settimeout(self.read_deadline_s)
+                    streaming = True
                 msg = unpacker.unpack(payload)
                 t_decode_end = time.time()
                 tr = unpacker.last_trace
@@ -645,6 +782,21 @@ class IngestServer:
                         ),
                     )
                 )
+        except PeerDeadError as e:
+            if not self._stop.is_set():
+                # The liveness verdict (docs/FLEET.md "Failure modes"): a
+                # peer that answered neither frames nor the PING probe is
+                # REAPED — connection closed, loudly attributed.  The
+                # supervisor restarts a wedged actor when its stall
+                # eventually crashes or exits it; a merely-slow actor
+                # reconnects by itself.
+                flight_event(
+                    "peer_dead",
+                    actor=actor,
+                    deadline_s=self.read_deadline_s,
+                    error=str(e),
+                )
+                self._obs_peer_dead.labels(actor=actor).inc()
         except (FrameError, OSError) as e:
             if not self._stop.is_set():
                 # A crashed actor's torn stream: note it and drop the
@@ -657,8 +809,71 @@ class IngestServer:
         finally:
             with self._lock:
                 self._conns.pop(ident, None)
+                self._conn_actors.pop(ident, None)
             try:
                 conn.close()
+            except OSError:
+                pass
+
+
+# -------------------------------------------------------- fleet checkpoints
+# The learner-recovery contract (docs/FLEET.md "Failure modes & recovery"):
+# a fleet checkpoint is the LEARNER subtree (params + targets + optimizer
+# states + step; utils/checkpoint.py light layout) plus this sidecar of
+# host-side monotone counters (env steps, episode sums, drained-phase
+# count, param version).  The replay arena is deliberately NOT
+# checkpointed — it is GBs of re-collectable experience — so a resumed run
+# re-enters the absorb-to-min_replay phase with fresh actor experience
+# before drain-learn phases continue, and every counter continues monotone
+# from where the checkpoint left it.
+def fleet_counters_path(directory: str, step: int) -> str:
+    return os.path.join(
+        os.path.abspath(directory), f"fleet_counters_{int(step)}.json"
+    )
+
+
+def save_fleet_counters(directory: str, step: int, counters: Dict) -> str:
+    """Atomically write the monotone-counter sidecar next to the orbax
+    step (tmp + rename: a torn write never masquerades as a counter
+    state).  Returns the path."""
+    path = fleet_counters_path(directory, step)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({k: float(v) for k, v in counters.items()}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def load_fleet_counters(directory: str, step: int) -> Dict[str, float]:
+    """Read the sidecar for ``step``; missing file -> empty dict (callers
+    warn loudly — counters would restart at zero, losing monotonicity
+    against the previous incarnation's logs)."""
+    path = fleet_counters_path(directory, step)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return {k: float(v) for k, v in json.load(f).items()}
+
+
+def prune_fleet_counters(directory: str, keep_steps) -> None:
+    """Drop sidecars whose orbax step was garbage-collected (max_to_keep),
+    so the two never drift apart on disk."""
+    keep = {int(s) for s in keep_steps}
+    directory = os.path.abspath(directory)
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return
+    for name in names:
+        if not (name.startswith("fleet_counters_") and name.endswith(".json")):
+            continue
+        try:
+            step = int(name[len("fleet_counters_"):-len(".json")])
+        except ValueError:
+            continue
+        if step not in keep:
+            try:
+                os.unlink(os.path.join(directory, name))
             except OSError:
                 pass
 
@@ -699,6 +914,9 @@ class FleetLearner:
             startup_shed_grace_s=config.startup_shed_grace_s,
             max_frame_bytes=config.max_frame_bytes,
             wire_config=config.wire,
+            read_deadline_s=config.heartbeat_s,
+            warmup_deadline_s=config.warmup_deadline_s,
+            auth_token=config.auth_token,
         )
         self._drain_prog = jax.jit(
             lambda ls, st: drain_staged(
@@ -725,13 +943,15 @@ class FleetLearner:
             "staged batches stacked into the most recent compiled drain",
         )
         self._stats: Dict[str, float] = {}
+        self._counters: Dict[str, float] = {}
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> str:
-        """Bind + start the ingest server; returns the resolved address the
-        supervisor hands to actor subprocesses."""
+        """Bind + start the ingest server; returns the resolved DIALABLE
+        address the supervisor hands to actor subprocesses (loopback for a
+        wildcard bind — ``IngestServer.connect_address``)."""
         self.server.start()
-        return self.server.address
+        return self.server.connect_address
 
     def close(self) -> None:
         """Stop the ingest server.  Callers stop the SUPERVISOR first: an
@@ -747,6 +967,26 @@ class FleetLearner:
         bench probe's headline)."""
         return dict(self._stats)
 
+    def counters(self) -> Dict[str, float]:
+        """The monotone counters as of the most recent ``run``'s end — the
+        values the FINAL checkpoint's sidecar must record so a later
+        ``--resume`` continues them (train.py writes it next to
+        ``save_final``)."""
+        return dict(self._counters)
+
+    def _save_checkpoint(
+        self, ckpt, step: int, state, cstate, lstate, counters: Dict
+    ) -> None:
+        """One periodic learner checkpoint: the merged state (a LIGHT
+        manager persists only the ``train`` subtree — params, targets,
+        optimizer, step) plus the monotone-counter sidecar, pruned in
+        lockstep with orbax's ``max_to_keep``.  Runs on the drain thread
+        between phases; the synchronous save completes before the next
+        drain call donates ``lstate``'s buffers."""
+        ckpt.save(step, merge_state(state, cstate, lstate))
+        save_fleet_counters(ckpt.directory, step, counters)
+        prune_fleet_counters(ckpt.directory, ckpt.all_steps())
+
     # ------------------------------------------------------------------- run
     def run(
         self,
@@ -756,12 +996,27 @@ class FleetLearner:
         log_fn=print,
         metrics_fn: Optional[Callable[[int, Dict[str, float]], None]] = None,
         minutes: Optional[float] = None,
+        ckpt=None,
+        checkpoint_every: int = 0,
+        resume_from: Optional[Dict[str, float]] = None,
+        phase_fn: Optional[Callable[[int], None]] = None,
     ) -> TrainerState:
         """Absorb staged batches until ``min_replay`` sequences are
         resident, then run ``num_train_phases`` drain-learn phases (one
         staged batch + K updates each — the phase-locked data-to-update
         ratio, fed from the fleet).  The server must already be started;
-        the caller owns actor lifecycle (supervisor)."""
+        the caller owns actor lifecycle (supervisor).
+
+        ``ckpt`` (a LIGHT ``utils.CheckpointManager``) + ``checkpoint_every``
+        arm periodic learner checkpoints: the learner subtree is saved
+        every N drain phases with the monotone-counter sidecar
+        (``save_fleet_counters``) — the recovery contract's durable half.
+        ``resume_from`` (``load_fleet_counters`` of the restored step)
+        continues counters, phase numbering and param versions where the
+        previous incarnation left them; ``num_train_phases`` stays the
+        TOTAL target across incarnations.  ``phase_fn(drained)`` runs
+        after every drain-learn phase — the chaos engine's injection hook
+        (fleet/chaos.py)."""
         if self.server.address is None:
             raise RuntimeError("call start() before run()")
         t = self.trainer
@@ -771,19 +1026,25 @@ class FleetLearner:
             time.monotonic() + minutes * 60 if minutes is not None else None
         )
         self.learner_wait.reset()
-        version = 1
+        resume_from = resume_from or {}
+        version = int(resume_from.get("param_version", 0)) + 1
         self.server.publish_params(version, self._snapshot_params(lstate))
 
         min_seqs = t.config.min_replay
         absorbed = 0
-        drained = 0
+        # Monotone across learner incarnations: a resumed run continues
+        # the drained-phase count and the host-side sums exactly where the
+        # checkpoint's sidecar left them (the recovery contract).
+        drained = int(resume_from.get("drained", 0))
+        drained_at_start = drained
         last_metrics: Dict[str, Any] = {}
         # Host-side episode accounting: actors drain their device
         # accumulators each phase and ship DELTAS as plain floats, so the
         # sums here stay monotone across supervised actor restarts.
-        ep_ret_sum = 0.0
-        ep_count = 0.0
-        env_steps_total = 0.0
+        ep_ret_sum = float(resume_from.get("ep_return_sum", 0.0))
+        ep_count = float(resume_from.get("ep_count", 0.0))
+        env_steps_total = float(resume_from.get("env_steps_total", 0.0))
+        episodes_total = float(resume_from.get("episodes_total", 0.0))
         last_batch_t = time.monotonic()
         t0 = time.monotonic()
         # Steady-state window for throughput claims: everything before the
@@ -849,6 +1110,7 @@ class FleetLearner:
                 env_steps_total += shed_stats["env_steps_delta"]
                 ep_ret_sum += shed_stats["ep_return_sum"]
                 ep_count += shed_stats["ep_count"]
+                episodes_total += shed_stats["ep_count"]
                 staged = stack_staged([m["staged"] for m in msgs])
                 t_stack_end = time.time()
                 # Sampled batches' hops (obs/trace.py): absorb phases are
@@ -860,6 +1122,7 @@ class FleetLearner:
                 for msg in msgs:
                     ep_ret_sum += float(msg.get("ep_return_sum", 0.0))
                     ep_count += float(msg.get("ep_count", 0.0))
+                    episodes_total += float(msg.get("ep_count", 0.0))
                     env_steps_total += float(msg.get("env_steps_delta", 0.0))
                 absorbed += n_seqs
                 # staged_writer around the COMPILED call: inside the jit
@@ -924,6 +1187,26 @@ class FleetLearner:
                     # Startup is over: handlers now shed on the real
                     # shed_after_s bound instead of the compile grace.
                     self.server.mark_steady()
+                if phase_fn is not None:
+                    # The chaos engine's drain-clock hook (fleet/chaos.py):
+                    # learner-boundary faults fire here, between phases.
+                    phase_fn(drained)
+                if (
+                    ckpt is not None
+                    and checkpoint_every > 0
+                    and drained % checkpoint_every == 0
+                ):
+                    self._save_checkpoint(
+                        ckpt, drained, state, cstate, lstate,
+                        {
+                            "drained": drained,
+                            "env_steps_total": env_steps_total,
+                            "ep_return_sum": ep_ret_sum,
+                            "ep_count": ep_count,
+                            "episodes_total": episodes_total,
+                            "param_version": version,
+                        },
+                    )
                 if drained % max(self.config.publish_every, 1) == 0:
                     version += 1
                     self.server.publish_params(
@@ -954,12 +1237,24 @@ class FleetLearner:
             wall = max(time.monotonic() - t0, 1e-9)
             _, lw_total, lw_p50, lw_p99 = self.learner_wait.snapshot()
             srv = self.server
+            # Rates are per-INCARNATION (phases this process ran over this
+            # process's wall clock); the monotone totals live in counters().
+            drained_here = drained - drained_at_start
+            self._counters = {
+                "drained": float(drained),
+                "env_steps_total": env_steps_total,
+                "ep_return_sum": ep_ret_sum,
+                "ep_count": ep_count,
+                "episodes_total": episodes_total,
+                "param_version": float(version),
+            }
             self._stats = {
-                "train_phases": float(drained),
+                "train_phases": float(drained_here),
+                "train_phases_total": float(drained),
                 "absorbed_seqs": float(absorbed),
                 "wall_s": wall,
                 "learner_steps_per_sec": (
-                    drained * t.config.learner_steps / wall
+                    drained_here * t.config.learner_steps / wall
                 ),
                 "arena_add_seqs_per_sec": absorbed / wall,
                 "sheds": float(self.server.shed_total),
@@ -990,7 +1285,9 @@ class FleetLearner:
                     absorbed - seqs_at_train_t0
                 ) / train_wall
                 self._stats["train_learner_steps_per_sec"] = (
-                    max(drained - 1, 0) * t.config.learner_steps / train_wall
+                    max(drained_here - 1, 0)
+                    * t.config.learner_steps
+                    / train_wall
                 )
         # phase_idx is a collector-slice field the fleet learner never
         # advances; stamp the drained-phase count so the final checkpoint

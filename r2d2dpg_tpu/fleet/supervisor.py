@@ -28,7 +28,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from r2d2dpg_tpu.obs import flight_event, get_registry
-from r2d2dpg_tpu.utils.codes import EXIT_WIRE_REFUSED
+from r2d2dpg_tpu.utils.codes import TERMINAL_ACTOR_EXITS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +67,7 @@ class ActorSupervisor:
         config: SupervisorConfig = SupervisorConfig(),
         env: Optional[Dict[str, str]] = None,
         log_path_fn: Optional[Callable[[int], str]] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         if num_actors < 1:
             raise ValueError("num_actors must be >= 1")
@@ -74,6 +75,11 @@ class ActorSupervisor:
         self.num_actors = num_actors
         self.config = config
         self.log_path_fn = log_path_fn
+        # Injectable clock: the backoff/give-up timing contract is tested
+        # against a FAKE clock (tests drive _poll_once directly), so the
+        # healthy-uptime reset and restart_at deadlines are pinned without
+        # real sleeps.
+        self._clock = clock
         self._env = dict(os.environ if env is None else env)
         # CPU discipline (module docstring): clear the axon sitecustomize
         # gate so the plugin never registers in the child, and pin cpu.
@@ -147,13 +153,19 @@ class ActorSupervisor:
         with self._lock:
             return sum(s.restarts for s in self._slots.values())
 
-    def kill_actor(self, actor_id: int) -> None:
+    def kill_actor(self, actor_id: int) -> bool:
         """Test/drill hook: hard-kill one actor (the supervisor sees a
-        crash and walks the restart path — the soak test's lever)."""
+        crash and walks the restart path — the soak test's lever).
+        Returns True when a kill was actually delivered — False for a slot
+        that is already a corpse or mid-backoff, so a chaos drill can tell
+        a real injection from a no-op (fleet/chaos.py keeps no-ops
+        pending instead of recording a drill that never ran)."""
         with self._lock:
             proc = self._slots[actor_id].proc
         if proc is not None and proc.poll() is None:
             proc.kill()
+            return True
+        return False
 
     # -------------------------------------------------------------- internal
     def _spawn(self, actor_id: int) -> None:
@@ -172,93 +184,99 @@ class ActorSupervisor:
         finally:
             if stdout is not subprocess.DEVNULL:
                 stdout.close()  # child holds its own fd
-        slot.started_at = time.monotonic()
+        slot.started_at = self._clock()
         slot.restart_at = None
 
     def _monitor_loop(self) -> None:
-        cfg = self.config
         while not self._stopping.is_set():
-            now = time.monotonic()
-            with self._lock:
-                for actor_id, slot in self._slots.items():
-                    if slot.gave_up:
-                        continue
-                    if slot.proc is not None and slot.proc.poll() is None:
-                        # Healthy uptime resets the backoff ladder.
-                        if (
-                            slot.consecutive_crashes
-                            and now - slot.started_at > cfg.healthy_after_s
-                        ):
-                            slot.consecutive_crashes = 0
-                        continue
-                    if slot.proc is not None and slot.restart_at is None:
-                        # Fresh corpse: record, arm the backoff.
-                        rc = slot.proc.returncode
-                        slot.consecutive_crashes += 1
-                        backoff = min(
-                            cfg.backoff_base_s
-                            * (2 ** (slot.consecutive_crashes - 1)),
-                            cfg.backoff_max_s,
-                        )
-                        flight_event(
-                            "actor_crash",
-                            actor=actor_id,
-                            returncode=rc,
-                            restarts=slot.restarts,
-                            backoff_s=round(backoff, 3),
-                        )
-                        if rc == EXIT_WIRE_REFUSED:
-                            # Deterministic wire-negotiation mismatch:
-                            # every restart would be refused again within
-                            # milliseconds (healthy_after_s never resets
-                            # the ladder) — give the slot up NOW with a
-                            # terminal event instead of churning forever.
-                            slot.gave_up = True
-                            flight_event(
-                                "actor_gave_up",
-                                actor=actor_id,
-                                restarts=slot.restarts,
-                                reason="wire_refused",
-                            )
-                            continue
-                        if (
-                            cfg.max_restarts is not None
-                            and slot.restarts >= cfg.max_restarts
-                        ):
-                            slot.gave_up = True
-                            flight_event(
-                                "actor_gave_up",
-                                actor=actor_id,
-                                restarts=slot.restarts,
-                            )
-                            continue
-                        slot.restart_at = now + backoff
+            self._poll_once(self._clock())
+            self._stopping.wait(self.config.poll_s)
+
+    def _poll_once(self, now: float) -> None:
+        """One supervision pass at time ``now`` — the whole timing contract
+        (healthy-uptime ladder reset, backoff arming, restart_at deadline,
+        give-up paths) in one directly-testable step (the fake-clock tests
+        call this; the monitor thread calls it on ``poll_s``)."""
+        cfg = self.config
+        with self._lock:
+            for actor_id, slot in self._slots.items():
+                if slot.gave_up:
+                    continue
+                if slot.proc is not None and slot.proc.poll() is None:
+                    # Healthy uptime resets the backoff ladder.
                     if (
-                        slot.restart_at is not None
-                        and now >= slot.restart_at
+                        slot.consecutive_crashes
+                        and now - slot.started_at > cfg.healthy_after_s
                     ):
-                        # A failed spawn (logdir vanished, ENOSPC, exec
-                        # error) must not kill THIS thread — supervision
-                        # is the subsystem's headline feature.  Note it
-                        # and retry on the max backoff.
-                        try:
-                            self._spawn(actor_id)
-                        except Exception as e:  # noqa: BLE001
-                            flight_event(
-                                "actor_spawn_failed",
-                                actor=actor_id,
-                                error=f"{type(e).__name__}: {e}",
-                            )
-                            slot.restart_at = now + cfg.backoff_max_s
-                            continue
-                        slot.restarts += 1
-                        self._obs_restarts.inc()
+                        slot.consecutive_crashes = 0
+                    continue
+                if slot.proc is not None and slot.restart_at is None:
+                    # Fresh corpse: record, arm the backoff.
+                    rc = slot.proc.returncode
+                    slot.consecutive_crashes += 1
+                    backoff = min(
+                        cfg.backoff_base_s
+                        * (2 ** (slot.consecutive_crashes - 1)),
+                        cfg.backoff_max_s,
+                    )
+                    flight_event(
+                        "actor_crash",
+                        actor=actor_id,
+                        returncode=rc,
+                        restarts=slot.restarts,
+                        backoff_s=round(backoff, 3),
+                    )
+                    if rc in TERMINAL_ACTOR_EXITS:
+                        # Deterministic HELLO refusal (wire mismatch or
+                        # auth failure): every restart would be refused
+                        # again within milliseconds (healthy_after_s never
+                        # resets the ladder) — give the slot up NOW with a
+                        # terminal event instead of churning forever.
+                        slot.gave_up = True
                         flight_event(
-                            "actor_restart",
+                            "actor_gave_up",
+                            actor=actor_id,
+                            restarts=slot.restarts,
+                            reason=TERMINAL_ACTOR_EXITS[rc],
+                        )
+                        continue
+                    if (
+                        cfg.max_restarts is not None
+                        and slot.restarts >= cfg.max_restarts
+                    ):
+                        slot.gave_up = True
+                        flight_event(
+                            "actor_gave_up",
                             actor=actor_id,
                             restarts=slot.restarts,
                         )
-            self._stopping.wait(cfg.poll_s)
+                        continue
+                    slot.restart_at = now + backoff
+                if (
+                    slot.restart_at is not None
+                    and now >= slot.restart_at
+                ):
+                    # A failed spawn (logdir vanished, ENOSPC, exec
+                    # error) must not kill THIS thread — supervision
+                    # is the subsystem's headline feature.  Note it
+                    # and retry on the max backoff.
+                    try:
+                        self._spawn(actor_id)
+                    except Exception as e:  # noqa: BLE001
+                        flight_event(
+                            "actor_spawn_failed",
+                            actor=actor_id,
+                            error=f"{type(e).__name__}: {e}",
+                        )
+                        slot.restart_at = now + cfg.backoff_max_s
+                        continue
+                    slot.restarts += 1
+                    self._obs_restarts.inc()
+                    flight_event(
+                        "actor_restart",
+                        actor=actor_id,
+                        restarts=slot.restarts,
+                    )
 
 
 def default_actor_argv(

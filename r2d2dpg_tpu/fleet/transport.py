@@ -24,9 +24,11 @@ sites only; ``scripts/lint_fleet_wire.sh`` enforces the whitelist), while the
 steady-state tensor frames (SEQS/PARAMS) carry the zero-copy binary
 format of ``fleet/wire.py`` — schema-cached headers plus raw contiguous
 tensor bytes, sent without intermediate copies via ``send_frame_parts``.
-Integrity, not authentication — both ends are subprocesses of one trusted
-training run on one host (the supervisor spawns the actors); never point
-an ingest server at an untrusted network.
+Integrity at this layer; authentication lives one layer up — the ingest
+server checks an optional ``--fleet-token`` shared secret at HELLO
+(``fleet/ingest.py``), the prerequisite for routable (non-loopback)
+binds.  Never point an unauthenticated ingest server at an untrusted
+network.
 
 Backpressure is explicit, not buffered: ``send_frame`` uses a blocking
 ``sendall`` on a socket whose send buffer is clamped small
@@ -35,15 +37,24 @@ experience frame (``fleet/ingest.py``) — an actor has at most ONE
 unacknowledged batch in flight, so a stalled learner stalls actors at the
 next send instead of ballooning kernel buffers with stale experience.
 Shed codes ride the acks (``utils/codes.py``).
+
+Liveness is bounded, not assumed: both wire ends arm a read deadline
+(``settimeout``; ``READ_DEADLINE_S`` default) so no blocking read ever
+hangs forever on a wedged peer.  A silent deadline sends one PING and a
+second silence reaps the peer (``recv_frame_heartbeat`` ->
+``PeerDeadError``): the ingest handler closes the connection with a
+``peer_dead`` flight event, an actor exits with a retryable code and the
+supervisor's backoff restart takes over (docs/FLEET.md "Failure modes").
 """
 
 from __future__ import annotations
 
+import json
 import pickle
 import socket
 import struct
 import zlib
-from typing import Any, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -52,12 +63,15 @@ _HEADER = struct.Struct("!4sBQI")  # magic, kind, payload length, crc32
 HEADER_BYTES = _HEADER.size
 
 # Frame kinds (one byte on the wire).
-K_HELLO = 1  # actor -> ingest: {"actor_id", ...} once per connection
+K_HELLO = 1  # actor -> ingest: {"actor_id", ...} once per connection (JSON
+# — the one frame parsed BEFORE authentication; see pack_hello)
 K_SEQS = 2  # actor -> ingest: one staged experience batch + actor stats
 K_ACK = 3  # ingest -> actor: {"code": OK|SHED_INGEST, "param_version": v}
 K_PARAMS = 4  # ingest -> actor: {"version": v, "params": {...numpy trees}}
 K_BYE = 5  # either side: orderly goodbye
 K_TELEM = 6  # actor -> ingest: registry-scalar snapshot (~1 Hz, no ack)
+K_PING = 7  # either side: liveness probe after a silent read deadline
+K_PONG = 8  # either side: liveness answer (any frame also proves liveness)
 
 # 256 MiB default ceiling: a humanoid-shaped staged batch (256 envs x seq
 # 85) is ~20 MiB, so this bounds corruption blast radius without touching
@@ -69,6 +83,15 @@ MAX_FRAME_BYTES = 256 * 1024 * 1024
 # send in seconds (the backpressure signal), not minutes of kernel-buffered
 # stale experience.
 SOCKET_BUF_BYTES = 1 * 1024 * 1024
+
+# Default read deadline on both wire ends: a blocking read that sees no
+# bytes for this long raises ``FrameDeadline`` (the reader then PINGs once
+# and reaps the peer on a second silent deadline — ``recv_frame_heartbeat``).
+# Generous on purpose: the longest LEGITIMATE silence on the fleet wire is
+# an actor awaiting its ack while the learner's first drain-learn compiles
+# behind a full staging queue (up to ``startup_shed_grace_s`` ~120 s), so
+# the default deadline must dominate it.  Drills and tests dial it down.
+READ_DEADLINE_S = 300.0
 
 
 class FrameError(Exception):
@@ -89,6 +112,29 @@ class FrameTooLarge(FrameError):
 
 class FrameBadMagic(FrameError):
     """Stream is not positioned at a frame boundary (or not our protocol)."""
+
+
+class FrameDeadline(FrameError):
+    """No bytes arrived within the socket's read deadline (peer silent).
+
+    ``mid_frame`` distinguishes the two silences: ``False`` = the stream
+    is AT a frame boundary (nothing consumed — safe to PING and keep
+    reading), ``True`` = bytes of a frame were already consumed (or its
+    header was), so the stream can never be resynchronized and the only
+    honest verdict is to reap the peer."""
+
+    def __init__(self, msg: str, *, mid_frame: bool = False):
+        super().__init__(msg)
+        self.mid_frame = mid_frame
+
+
+class PeerDeadError(FrameError):
+    """Peer stayed silent through a deadline AND the PING that followed it.
+
+    The liveness verdict of ``recv_frame_heartbeat``: the connection is
+    reaped (ingest handler closes + ``peer_dead`` flight event; an actor
+    exits with a retryable code so the supervisor's backoff restart takes
+    over) instead of hanging forever on a wedged peer."""
 
 
 # ------------------------------------------------------------------ framing
@@ -168,7 +214,17 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     chunks = []
     got = 0
     while got < n:
-        chunk = sock.recv(min(n - got, 1 << 20))
+        try:
+            chunk = sock.recv(min(n - got, 1 << 20))
+        except socket.timeout:
+            # The socket's read deadline (settimeout) fired: the peer went
+            # silent — between frames (got == 0) or mid-frame (a torn
+            # write from a wedged sender).  Either way the read is bounded:
+            # this surfaces as FrameDeadline instead of hanging forever.
+            raise FrameDeadline(
+                f"no bytes within the read deadline ({got}/{n} received)",
+                mid_frame=got > 0,
+            )
         if not chunk:
             raise FrameTruncated(f"EOF after {got}/{n} bytes")
         chunks.append(chunk)
@@ -191,7 +247,15 @@ def recv_frame(
             f"declared payload {length}B exceeds frame ceiling "
             f"{max_frame_bytes}B"
         )
-    payload = _recv_exact(sock, length)
+    try:
+        payload = _recv_exact(sock, length)
+    except FrameDeadline as e:
+        # The header is already consumed: even a deadline whose payload
+        # read got 0 bytes leaves the stream mid-frame — a later retry
+        # would parse payload bytes as a header (FrameBadMagic) instead
+        # of reaching the liveness verdict.
+        e.mid_frame = True
+        raise
     if zlib.crc32(payload) != crc:
         raise FrameCRCError(
             f"crc mismatch on {length}B payload (kind {kind})"
@@ -199,14 +263,120 @@ def recv_frame(
     return kind, payload
 
 
+def recv_frame_heartbeat(
+    sock: socket.socket,
+    *,
+    max_frame_bytes: int = MAX_FRAME_BYTES,
+    bytes_in=None,
+    bytes_out=None,
+) -> Tuple[int, bytes]:
+    """Deadline-aware framed read with PING/PONG liveness, both wire ends.
+
+    Reads until a NON-heartbeat frame arrives.  A first silent read
+    deadline (``FrameDeadline`` — the socket's ``settimeout``) sends one
+    PING and waits a second deadline for ANY frame; a second silence is
+    the liveness verdict: ``PeerDeadError``, and the caller reaps the
+    connection.  An incoming PING is answered with PONG (the peer is
+    probing us); a PONG — or any real frame — proves the peer alive and
+    re-arms the probe.  A socket with no timeout set never deadlines,
+    which degrades to plain ``recv_frame`` semantics.
+
+    ``bytes_in``/``bytes_out``, when given, are called with the wire byte
+    counts of the heartbeat frames this helper consumes/produces, so the
+    obs byte counters stay honest about probe traffic."""
+    pinged = False
+    while True:
+        try:
+            kind, payload = recv_frame(sock, max_frame_bytes=max_frame_bytes)
+        except FrameDeadline as e:
+            if e.mid_frame:
+                # Partial frame consumed: the stream cannot resynchronize
+                # (a retry would parse leftover payload as a header), so
+                # a mid-frame stall goes straight to the liveness verdict
+                # instead of a PING whose answer we could never read.
+                raise PeerDeadError(
+                    f"peer stalled mid-frame past the read deadline ({e})"
+                )
+            if pinged:
+                raise PeerDeadError(
+                    f"peer silent through a read deadline and the PING "
+                    f"that followed it ({e})"
+                )
+            n = send_frame(sock, K_PING, b"")
+            if bytes_out is not None:
+                bytes_out(n)
+            pinged = True
+            continue
+        pinged = False  # ANY frame proves the peer alive, not just PONG
+        if bytes_in is not None and kind in (K_PING, K_PONG):
+            bytes_in(HEADER_BYTES + len(payload))
+        if kind == K_PING:
+            n = send_frame(sock, K_PONG, b"")
+            if bytes_out is not None:
+                bytes_out(n)
+            continue
+        if kind == K_PONG:
+            continue
+        return kind, payload
+
+
+# --------------------------------------------------------------------- auth
+def hello_auth_proof(token: str) -> str:
+    """The HELLO authentication proof for a shared ``--fleet-token``.
+
+    An HMAC over a fixed context string rather than the raw token, so the
+    secret itself never crosses the wire (a captured HELLO replays this
+    one protocol's HELLO and nothing else — the cross-host threat model is
+    a misdirected or stale peer, not an active MITM; that needs TLS).
+    Both ends compute it; the ingest server compares with
+    ``hmac.compare_digest`` (fleet/ingest.py)."""
+    import hashlib
+    import hmac as _hmac
+
+    return _hmac.new(
+        token.encode(), b"r2d2dpg-fleet-hello-v1", hashlib.sha256
+    ).hexdigest()
+
+
+def pack_hello(hello: Dict[str, Any]) -> bytes:
+    """Encode a HELLO payload — JSON, never pickle.
+
+    HELLO is the ONE frame a learner parses from a peer it has not yet
+    authenticated (the ``--fleet-token`` proof rides INSIDE it), so its
+    decoder must be data-only: a pickle here would hand arbitrary code
+    execution to anything that can reach a routable bind, before the auth
+    check ever runs.  Every field both ends exchange (ids, counts, the
+    negotiation strings, the hex proof) is JSON-native."""
+    return json.dumps(hello).encode("utf-8")
+
+
+def unpack_hello(payload: bytes) -> Dict[str, Any]:
+    """Decode a HELLO payload (see ``pack_hello``: JSON, safe on
+    untrusted bytes).  Malformed payloads raise ``FrameError`` — the
+    caller drops the connection, the same posture as any protocol
+    violation."""
+    try:
+        hello = json.loads(payload.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise FrameError(f"malformed HELLO (JSON object expected): {e}")
+    if not isinstance(hello, dict):
+        raise FrameError(
+            f"malformed HELLO: JSON object expected, got {type(hello).__name__}"
+        )
+    return hello
+
+
 # ----------------------------------------------------------------- payloads
 def pack_obj(obj: Any) -> bytes:
-    """Serialize one CONTROL-frame payload (HELLO/ACK/BYE dicts).
+    """Serialize one POST-AUTH control-frame payload (ACK/BYE dicts).
 
     Pickle is banned from the SEQS/PARAMS steady-state paths
     (``scripts/lint_fleet_wire.sh``): tensor payloads go through
-    ``fleet/wire.py``.  Control frames are small trusted dicts exchanged a
-    handful of times per phase — pickle's flexibility is fine there."""
+    ``fleet/wire.py``.  Control frames are small dicts exchanged a
+    handful of times per phase between AUTHENTICATED peers — pickle's
+    flexibility is fine there.  The one pre-auth frame, HELLO, must use
+    ``pack_hello``/``unpack_hello`` (JSON) instead: its bytes come from a
+    peer nothing has vouched for yet."""
     return pickle.dumps(obj, protocol=4)
 
 
@@ -249,8 +419,40 @@ def configure_socket(sock: socket.socket) -> socket.socket:
     return sock
 
 
-def connect(addr: str, *, timeout: float = 30.0) -> socket.socket:
-    """Dial an ingest server; returns a configured, connected socket."""
+def is_loopback_address(addr: str) -> bool:
+    """True for addresses that PROVABLY never leave this host: Unix
+    sockets, literal 127.0.0.0/8 IPs and ``localhost``.  A wildcard or
+    routable bind — and any other hostname, which could resolve anywhere
+    (a name merely STARTING with "127." proves nothing) — is not loopback:
+    callers warn loudly when binding one without ``--fleet-token``
+    (docs/FLEET.md "Authentication")."""
+    if addr.startswith("unix:"):
+        return True
+    host, _, _ = addr.rpartition(":")
+    if host == "localhost":
+        return True
+    import ipaddress
+
+    try:
+        return ipaddress.ip_address(host).is_loopback
+    except ValueError:
+        return False  # a hostname, not a literal IP: not provably local
+
+
+def connect(
+    addr: str,
+    *,
+    timeout: float = 30.0,
+    read_deadline_s: Optional[float] = READ_DEADLINE_S,
+) -> socket.socket:
+    """Dial an ingest server; returns a configured, connected socket.
+
+    ``read_deadline_s`` arms the socket's blocking-I/O timeout: a read (or
+    a backpressured send) that makes no progress for that long raises
+    instead of hanging forever — ``recv_frame`` surfaces it as
+    ``FrameDeadline`` and ``recv_frame_heartbeat`` turns it into the
+    PING-then-reap liveness protocol.  ``None`` restores the legacy
+    unbounded posture (debug only)."""
     family, target = parse_address(addr)
     sock = socket.socket(family, socket.SOCK_STREAM)
     sock.settimeout(timeout)
@@ -259,5 +461,5 @@ def connect(addr: str, *, timeout: float = 30.0) -> socket.socket:
     except OSError:
         sock.close()
         raise
-    sock.settimeout(None)
+    sock.settimeout(read_deadline_s)
     return configure_socket(sock)

@@ -106,6 +106,34 @@ def parse_args(argv=None) -> argparse.Namespace:
         "are bucketed to powers of two <= K to bound drain-program "
         "compiles)"
     )
+    # Fleet fault tolerance (docs/FLEET.md "Failure modes & recovery").
+    p.add_argument(
+        "--fleet-heartbeat", type=float, default=None, metavar="S",
+        help="liveness read deadline on both fleet wire ends (default "
+        "300): a peer silent past it is PINGed once and reaped on a "
+        "second silence (peer_dead flight event; the actor exits "
+        "retryably and the supervisor restarts it)"
+    )
+    p.add_argument(
+        "--fleet-token", default=None,
+        help="shared HELLO-authentication secret (hmac.compare_digest at "
+        "the ingest door; mismatched actors are refused with "
+        "REFUSED_AUTH).  REQUIRED practice for non-loopback "
+        "--fleet-address binds; defaults to $R2D2DPG_FLEET_TOKEN — "
+        "PREFER the env var, an argv secret is readable in ps — and is "
+        "passed to spawned actors via the environment, never their "
+        "command line"
+    )
+    p.add_argument(
+        "--chaos-spec", default=None, metavar="SPEC",
+        help="seeded fault-injection schedule (fleet/chaos.py), e.g. "
+        "'kill_actor@p3,stall_actor@p5:4s,corrupt_frame@p7,"
+        "kill_ingest_conn@p9' — each fault fires once at its drain/actor "
+        "phase, at a real boundary (SIGKILL, sleep, byte flip, socket "
+        "close), and must recover through the documented path; every "
+        "injection lands in flight.jsonl + "
+        "r2d2dpg_fleet_chaos_drills_total"
+    )
     # Agent/exploration hyperparameter overrides (VERDICT r2 weak #3: probe
     # whether the walker plateau is data-bound or hparam-capped).
     p.add_argument("--sigma-max", type=float, default=None,
@@ -279,11 +307,12 @@ def run(args) -> dict:
         # The fleet learner owns the phase loop (actors own collection);
         # knobs that assume THIS process collects, or that another
         # executor owns the loop, are refused loudly rather than silently
-        # ignored (docs/FLEET.md "Mutually exclusive knobs").
+        # ignored (docs/FLEET.md "Mutually exclusive knobs").  --resume
+        # and periodic checkpoints are SUPPORTED since ISSUE 7 (the
+        # learner-recovery contract; docs/FLEET.md "Failure modes").
         for flag, bad in (
             ("--pipeline 1", args.pipeline),
             ("--spmd", args.spmd),
-            ("--resume", args.resume),
             ("--eval-every", args.eval_every),
             ("--profile-phases", args.profile_phases),
             ("--nan-inject-phase", args.nan_inject_phase is not None),
@@ -298,14 +327,30 @@ def run(args) -> dict:
         args.fleet_wire != "f32"
         or args.fleet_compress != "none"
         or args.drain_coalesce != 1
+        or args.chaos_spec is not None
+        or args.fleet_token is not None
+        or args.fleet_heartbeat is not None
     ):
-        # The wire/drain fast lane is a property of the fleet data path;
-        # the in-process schedules have no wire to shape — refuse rather
-        # than silently ignore (docs/FLEET.md "Mutually exclusive knobs").
+        # The wire/drain fast lane, heartbeat, auth and chaos knobs are
+        # properties of the fleet data path; the in-process schedules have
+        # no wire to shape — refuse rather than silently ignore
+        # (docs/FLEET.md "Mutually exclusive knobs").
         raise SystemExit(
-            "--fleet-wire/--fleet-compress/--drain-coalesce require "
+            "--fleet-wire/--fleet-compress/--drain-coalesce/"
+            "--fleet-heartbeat/--fleet-token/--chaos-spec require "
             "--actors N (the in-process schedules have no fleet wire)"
         )
+    if args.chaos_spec:
+        # Validate the grammar up front: a malformed drill schedule must
+        # refuse at startup, not after the fleet has spawned.
+        from r2d2dpg_tpu.fleet.chaos import parse_chaos_spec
+
+        try:
+            parse_chaos_spec(args.chaos_spec)
+        except ValueError as e:
+            raise SystemExit(f"--chaos-spec: {e}")
+    if args.fleet_heartbeat is not None and args.fleet_heartbeat <= 0:
+        raise SystemExit("--fleet-heartbeat must be > 0 seconds")
     if not 0.0 <= args.trace_sample <= 1.0:
         raise SystemExit("--trace-sample must be in [0, 1]")
     if args.trace_sample and not (args.actors or args.pipeline):
@@ -392,10 +437,23 @@ def run(args) -> dict:
 
     ckpt: Optional[CheckpointManager] = None
     if args.checkpoint_dir:
+        light = args.checkpoint_light
+        if args.actors and not light:
+            # The fleet recovery contract (docs/FLEET.md): a fleet
+            # checkpoint is the learner subtree + counter sidecar — the
+            # replay arena is NEVER checkpointed (GBs of re-collectable
+            # experience; resume re-enters absorb-to-min_replay).
+            print(
+                "fleet: checkpoints under --actors N are always light "
+                "(learner subtree + counters; the arena is re-absorbed "
+                "on resume — docs/FLEET.md)",
+                flush=True,
+            )
+            light = True
         ckpt = CheckpointManager(
             args.checkpoint_dir,
             save_every=args.checkpoint_every,
-            light=args.checkpoint_light,
+            light=light,
         )
 
     evaluator: Optional[Evaluator] = None
@@ -411,12 +469,15 @@ def run(args) -> dict:
         time.monotonic() + args.minutes * 60 if args.minutes is not None else None
     )
 
-    if args.resume:
-        if ckpt is None:
-            raise SystemExit("--resume requires --checkpoint-dir")
+    if args.resume and ckpt is None:
+        raise SystemExit("--resume requires --checkpoint-dir")
+    if args.resume and not args.actors:
         state = resume_state(trainer, ckpt)
         print(f"resumed from phase {int(state.phase_idx)}", flush=True)
     else:
+        # Fleet resume is handled inside _run_fleet: the learner never
+        # collects, so the generic resume_state's window-refill collect
+        # phases would compile a program this process never runs.
         state = trainer.init()
 
     if args.pipeline:
@@ -706,7 +767,10 @@ def _run_fleet(
         WireConfig,
         default_actor_argv,
     )
-    from r2d2dpg_tpu.obs import DivergenceError
+    from r2d2dpg_tpu.fleet import chaos as fleet_chaos
+    from r2d2dpg_tpu.fleet import transport as fleet_transport
+    from r2d2dpg_tpu.fleet.ingest import load_fleet_counters
+    from r2d2dpg_tpu.obs import DivergenceError, flight_event
 
     try:
         wire_config = WireConfig(
@@ -716,6 +780,42 @@ def _run_fleet(
         # e.g. zstd on a box without the zstandard module: refuse loudly
         # at startup, not with a crash-looping actor fleet.
         raise SystemExit(f"--fleet-compress: {e}")
+    # run() already validated the grammar (fail before the trainer build);
+    # this parse only materializes the Fault tuple.
+    chaos_faults = (
+        fleet_chaos.parse_chaos_spec(args.chaos_spec)
+        if args.chaos_spec
+        else ()
+    )
+    # $R2D2DPG_FLEET_TOKEN fallback, same as fleet/actor.py: a secret on
+    # the learner's own command line would sit in /proc/<pid>/cmdline for
+    # the run's whole lifetime — the exact exposure the env-var hand-off
+    # to actors avoids.  Resolved here (fleet-only path), so an exported
+    # token never trips the fleet-knobs-without---actors refusal.
+    fleet_token = (
+        args.fleet_token or os.environ.get("R2D2DPG_FLEET_TOKEN") or None
+    )
+    if not fleet_transport.is_loopback_address(
+        args.fleet_address
+    ) and not fleet_token:
+        # Routable bind without authentication: anyone who can reach the
+        # port can feed the learner experience (the frame parser is safe
+        # on untrusted bytes, but the TRAINING DATA would be attacker-
+        # chosen).  Allowed — trusted private networks exist — but never
+        # silently.
+        print(
+            f"fleet: WARNING — binding routable address "
+            f"{args.fleet_address!r} WITHOUT --fleet-token: any host that "
+            f"can reach this port can stream experience into training. "
+            f"Set --fleet-token (docs/FLEET.md 'Authentication').",
+            flush=True,
+        )
+        flight_event("fleet_unauthenticated_bind", address=args.fleet_address)
+    heartbeat_s = (
+        args.fleet_heartbeat
+        if args.fleet_heartbeat is not None
+        else fleet_transport.READ_DEADLINE_S
+    )
     learner = FleetLearner(
         trainer,
         FleetConfig(
@@ -726,6 +826,8 @@ def _run_fleet(
             idle_timeout_s=args.fleet_idle_timeout,
             wire=wire_config,
             drain_coalesce=args.drain_coalesce,
+            heartbeat_s=heartbeat_s,
+            auth_token=fleet_token,
         ),
     )
     address = learner.start()
@@ -733,11 +835,28 @@ def _run_fleet(
         f"fleet: ingest on {address}; spawning {args.actors} actors",
         flush=True,
     )
-    if ckpt is not None and ckpt.save_every and ckpt.save_every > 0:
+    # Learner recovery (docs/FLEET.md "Failure modes"): resume restores
+    # the learner subtree into a fresh state and continues the monotone
+    # counters from the checkpoint's sidecar; the arena is re-absorbed.
+    resume_from = None
+    if args.resume:
+        step = ckpt.latest_step
+        if step is None:
+            raise SystemExit(
+                f"--resume: no checkpoint found under {args.checkpoint_dir}"
+            )
+        state = dataclasses.replace(state, train=ckpt.restore(state))
+        resume_from = load_fleet_counters(args.checkpoint_dir, step)
+        if not resume_from:
+            print(
+                f"fleet: WARNING — checkpoint step {step} has no counter "
+                f"sidecar (pre-ISSUE-7 layout?); counters restart at 0",
+                flush=True,
+            )
         print(
-            "fleet: periodic checkpoints not supported with --actors N; "
-            "saving the final checkpoint only (--checkpoint-every -1 "
-            "semantics)",
+            f"fleet: resumed learner from step {step} "
+            f"(drained {int(resume_from.get('drained', 0))} phases, "
+            f"env_steps {resume_from.get('env_steps_total', 0.0):.0f})",
             flush=True,
         )
     # Forward the RESOLVED config values (not the raw flags): the actors'
@@ -764,6 +883,18 @@ def _run_fleet(
         extra += ["--telem-every", "1.0"]
     if args.trace_sample:
         extra += ["--trace-sample", str(args.trace_sample)]
+    # Liveness: one deadline per fleet, both wire ends (docs/FLEET.md).
+    extra += ["--read-deadline", str(heartbeat_s)]
+    if args.chaos_spec:
+        # Actors fire the stall/corrupt faults that target their id; the
+        # learner's engine fires the rest — same seeded schedule.
+        extra += ["--chaos-spec", args.chaos_spec]
+    spawn_env = None
+    if fleet_token:
+        # Via the environment, NOT argv: a command-line token would be
+        # visible to every user on the host in ps/procfs.
+        spawn_env = dict(os.environ)
+        spawn_env["R2D2DPG_FLEET_TOKEN"] = fleet_token
 
     def argv_fn(i: int):
         argv = default_actor_argv(
@@ -784,12 +915,22 @@ def _run_fleet(
     supervisor = ActorSupervisor(
         argv_fn,
         args.actors,
+        env=spawn_env,
         log_path_fn=(
             (lambda i: os.path.join(args.logdir, f"actor{i}.log"))
             if args.logdir
             else None
         ),
     )
+    engine = None
+    if chaos_faults:
+        engine = fleet_chaos.ChaosEngine(
+            chaos_faults,
+            seed=cfg.trainer.seed,
+            num_actors=args.actors,
+            supervisor=supervisor,
+            server=learner.server,
+        )
 
     if args.phases is not None:
         num_phases = args.phases
@@ -809,11 +950,37 @@ def _run_fleet(
             log_every=args.log_every,
             metrics_fn=metrics_fn,
             minutes=args.minutes,
+            ckpt=ckpt,
+            checkpoint_every=args.checkpoint_every,
+            resume_from=resume_from,
+            phase_fn=engine.on_phase if engine is not None else None,
         )
         _fold_executor_stats("fleet", learner.stats(), final)
         final["fleet_actor_restarts"] = float(supervisor.restarts_total)
+        if engine is not None and engine.unfired():
+            # A drill that never got its phase must not read as one that
+            # passed: name it loudly in the log and the flight ring.
+            names = [f"{f.kind}@p{f.phase}" for f in engine.unfired()]
+            print(
+                f"fleet: WARNING — chaos faults never fired (run too "
+                f"short?): {', '.join(names)}",
+                flush=True,
+            )
+            flight_event("chaos_unfired", faults=names)
         if ckpt is not None and ckpt.save_every:
-            ckpt.save_final(int(state.phase_idx), state)
+            from r2d2dpg_tpu.fleet.ingest import (
+                prune_fleet_counters,
+                save_fleet_counters,
+            )
+
+            step = int(state.phase_idx)
+            ckpt.save_final(step, state)
+            # The final counters sidecar: what a later --resume continues.
+            save_fleet_counters(ckpt.directory, step, learner.counters())
+            # The final save may have pushed an old orbax step past
+            # max_to_keep: prune its sidecar too, or the two drift on disk.
+            ckpt.wait()
+            prune_fleet_counters(ckpt.directory, ckpt.all_steps())
     except DivergenceError as e:
         _abort_on_divergence(e, flight, flight_path, ckpt)
     finally:
@@ -828,6 +995,28 @@ def _run_fleet(
             ckpt.wait()
             ckpt.close()
         logger.close()
+    if chaos_faults and args.logdir:
+        # Actor-boundary drills fire in the ACTOR processes; their
+        # evidence is the chaos_inject lines in the flight_actor*.jsonl
+        # dumps the teardown above just flushed.  A fault with no such
+        # line never fired (run too short, target crashed first) and must
+        # not read as a drill that passed — same contract as
+        # ChaosEngine.unfired() for the learner-side faults.
+        missing = fleet_chaos.actor_faults_unfired(
+            chaos_faults,
+            args.logdir,
+            seed=cfg.trainer.seed,
+            num_actors=args.actors,
+        )
+        if missing:
+            names = [f"{f.kind}@p{f.phase}" for f in missing]
+            print(
+                f"fleet: WARNING — actor-side chaos faults left no "
+                f"injection evidence in {args.logdir!r} (run too short? "
+                f"target kept crashing?): {', '.join(names)}",
+                flush=True,
+            )
+            flight_event("chaos_unfired", faults=names)
     return final
 
 

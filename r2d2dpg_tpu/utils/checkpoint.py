@@ -146,6 +146,11 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list:
+        """Steps currently on disk (post ``max_to_keep`` pruning) — the
+        fleet counter-sidecar pruning keys off this (fleet/ingest.py)."""
+        return list(self._mgr.all_steps() or [])
+
     def restore(self, template: Any) -> Any:
         """Restore the latest checkpoint into the structure of ``template``.
 

@@ -24,12 +24,22 @@ SHED_INGEST = "shed_ingest_queue_full"
 # not match the learner's negotiated fast lane (fleet/wire.py) — the
 # connection is refused outright; a fleet runs ONE wire format.
 REFUSED_WIRE = "refused_wire_mismatch"
+# Fleet ingest HELLO: the actor's --fleet-token proof does not match the
+# learner's shared secret (hmac.compare_digest; fleet/ingest.py) — refused
+# at the door with an ``auth_refused`` flight event, the prerequisite for
+# routable (non-loopback) ingest binds.
+REFUSED_AUTH = "refused_auth"
 SHUTDOWN = "shutdown"
 
-# Process exit code for a REFUSED_WIRE HELLO: the one actor failure that is
-# deterministic misconfiguration, not a transient crash.  The actor exits
-# with this code and the supervisor gives the slot up instead of walking
+# Process exit codes for refused HELLOs: the actor failures that are
+# deterministic misconfiguration, not transient crashes.  The actor exits
+# with these codes and the supervisor gives the slot up instead of walking
 # the restart ladder forever (fleet/actor.py main / fleet/supervisor.py).
 EXIT_WIRE_REFUSED = 64
+EXIT_AUTH_REFUSED = 65
+TERMINAL_ACTOR_EXITS = {
+    EXIT_WIRE_REFUSED: "wire_refused",
+    EXIT_AUTH_REFUSED: "auth_refused",
+}
 
 ALL_SHED_CODES = (SHED_QUEUE, SHED_SESSIONS, SHED_INGEST)

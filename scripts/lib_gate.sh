@@ -136,6 +136,10 @@ run_evidence() {
         echo "$dir: fleet determinism gate FAILED (attempt $attempt)"
         continue
       fi
+      if ! chaos_gate "$dir" "$@"; then
+        echo "$dir: chaos drill gate FAILED (attempt $attempt)"
+        continue
+      fi
       timeout --kill-after=30 --signal=TERM 1800 \
         env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
         python -m r2d2dpg_tpu.eval $evalflags \
@@ -223,6 +227,36 @@ fleet_gate() {
          -k determinism \
        > "$dir/fleet_gate.log" 2>&1; then
     touch "$dir/.fleet_determinism_ok"
+    return 0
+  fi
+  return 1
+}
+
+# Chaos drill gate (ISSUE 7): a run dir trained with --actors N may only
+# be blessed (.done) if the non-slow chaos drills pass on this checkout —
+# proof that every documented recovery path (heartbeat reap, CRC reject,
+# reconnect, backoff restart, checkpoint/resume) still recovers before
+# any fleet number becomes evidence (docs/FLEET.md "Failure modes &
+# recovery").  The deterministic seeded single-fault drills only; the
+# multi-fault subprocess soak stays a slow-marked pytest.  Same stamping
+# discipline as fleet_gate; non-fleet runs pass through untouched.
+#   chaos_gate <dir> <train args...>
+chaos_gate() {
+  local dir=$1
+  shift
+  case " $* " in
+    *" --actors "[1-9]*) ;;
+    *) return 0 ;;  # not a fleet run (or --actors 0): nothing to gate
+  esac
+  if [ -f "$dir/.chaos_drills_ok" ]; then
+    return 0
+  fi
+  if timeout --kill-after=30 900 \
+       env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+       python -m pytest tests/test_chaos.py -q -p no:cacheprovider \
+         -m 'not slow' \
+       > "$dir/chaos_gate.log" 2>&1; then
+    touch "$dir/.chaos_drills_ok"
     return 0
   fi
   return 1

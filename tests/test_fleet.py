@@ -32,6 +32,7 @@ from r2d2dpg_tpu.fleet.transport import (
     K_HELLO,
     K_PARAMS,
     K_SEQS,
+    pack_hello,
     pack_obj,
     recv_frame,
     send_frame,
@@ -111,10 +112,11 @@ def test_fleet_off_determinism_bit_identical(tmp_path):
 def test_train_cli_refuses_fleet_combos():
     from r2d2dpg_tpu import train
 
+    # --resume is NOT in this list since ISSUE 7: learner checkpoint/
+    # resume under --actors N is the fleet recovery contract.
     for flags in (
         ["--pipeline", "1"],
         ["--spmd", "2"],
-        ["--resume"],
         ["--eval-every", "5"],
         ["--profile-phases", "2"],
         ["--nan-inject-phase", "1"],
@@ -136,6 +138,9 @@ def test_train_cli_refuses_wire_flags_without_actors():
         ["--fleet-wire", "bf16"],
         ["--fleet-compress", "zlib"],
         ["--drain-coalesce", "4"],
+        ["--chaos-spec", "kill_actor@p1"],
+        ["--fleet-token", "s3cret"],
+        ["--fleet-heartbeat", "5"],
     ):
         args = train.parse_args(["--config", "pendulum_tiny", *flags])
         with pytest.raises(SystemExit, match="require --actors"):
@@ -166,7 +171,7 @@ def test_ingest_server_ack_shed_and_param_push():
         send_frame(
             sock,
             K_HELLO,
-            pack_obj({"actor_id": 3, **wire.negotiation_fields(wire.WireConfig())}),
+            pack_hello({"actor_id": 3, **wire.negotiation_fields(wire.WireConfig())}),
         )
         kind, payload = recv_frame(sock)
         assert kind == K_ACK
@@ -293,7 +298,7 @@ def test_ingest_stop_interrupts_startup_grace_wait():
     send_frame(
         sock,
         K_HELLO,
-        pack_obj({"actor_id": 0, **wire.negotiation_fields(wire.WireConfig())}),
+        pack_hello({"actor_id": 0, **wire.negotiation_fields(wire.WireConfig())}),
     )
     recv_frame(sock)  # hello ack
 
@@ -338,7 +343,7 @@ def test_ingest_refuses_wire_mismatch():
         send_frame(
             sock,
             K_HELLO,
-            pack_obj(
+            pack_hello(
                 {"actor_id": 0, **wire.negotiation_fields(wire.WireConfig())}
             ),
         )
@@ -354,7 +359,7 @@ def test_ingest_refuses_wire_mismatch():
         # Wrong protocol version (e.g. a pre-wire actor with no fields).
         sock = transport.connect(srv.address)
         sock.settimeout(10)
-        send_frame(sock, K_HELLO, pack_obj({"actor_id": 1}))
+        send_frame(sock, K_HELLO, pack_hello({"actor_id": 1}))
         kind, payload = recv_frame(sock)
         ack = unpack_obj(payload)
         assert kind == K_ACK and ack["code"] == REFUSED_WIRE
@@ -550,6 +555,109 @@ def test_add_staged_concurrent_writer_raises():
 
 
 # --------------------------------------------------------------- supervisor
+class _FakeProc:
+    """A poll()-able stand-in so the timing contract is tested without
+    real subprocesses or sleeps (the fake-clock tests drive _poll_once)."""
+
+    def __init__(self, returncode=None):
+        self.returncode = returncode
+
+    def poll(self):
+        return self.returncode
+
+
+def _fake_clock_supervisor(**cfg):
+    sup = ActorSupervisor(
+        lambda i: ["unused"],
+        1,
+        config=SupervisorConfig(**cfg),
+        clock=lambda: 0.0,
+    )
+    spawned = []
+
+    def fake_spawn(actor_id):
+        slot = sup._slots[actor_id]
+        slot.proc = _FakeProc()
+        slot.restart_at = None
+        spawned.append(actor_id)
+
+    sup._spawn = fake_spawn
+    return sup, spawned
+
+
+def test_supervisor_fake_clock_restart_at_deadline_honored():
+    """The backoff deadline is honored exactly: no respawn one tick before
+    ``restart_at``, respawn at it (pure _poll_once, fake clock)."""
+    sup, spawned = _fake_clock_supervisor(backoff_base_s=0.5)
+    slot = sup._slots[0]
+    slot.proc = _FakeProc(returncode=1)
+    slot.started_at = 90.0
+    sup._poll_once(100.0)  # corpse found: arms backoff, no spawn yet
+    assert slot.restart_at == 100.5 and not spawned
+    sup._poll_once(100.49)  # one tick early: still waiting
+    assert not spawned
+    sup._poll_once(100.5)  # deadline: respawn
+    assert spawned == [0] and sup.restarts_total == 1
+
+
+def test_supervisor_fake_clock_backoff_doubles_and_caps():
+    sup, spawned = _fake_clock_supervisor(backoff_base_s=0.5, backoff_max_s=2.0)
+    slot = sup._slots[0]
+    now = 100.0
+    deltas = []
+    for _ in range(4):
+        slot.proc = _FakeProc(returncode=1)
+        slot.restart_at = None
+        sup._poll_once(now)
+        deltas.append(slot.restart_at - now)
+        now = slot.restart_at
+        sup._poll_once(now)  # respawn at the deadline
+        now += 1.0
+    assert deltas == [0.5, 1.0, 2.0, 2.0]  # doubles, then the cap
+    assert len(spawned) == 4
+
+
+def test_supervisor_fake_clock_healthy_uptime_resets_ladder():
+    """An incarnation that survives ``healthy_after_s`` resets the
+    consecutive-crash ladder: the NEXT crash backs off from base again."""
+    sup, _ = _fake_clock_supervisor(
+        backoff_base_s=0.5, backoff_max_s=30.0, healthy_after_s=60.0
+    )
+    slot = sup._slots[0]
+    slot.proc = _FakeProc(returncode=1)
+    slot.started_at = 0.0
+    sup._poll_once(10.0)  # crash #1: ladder at 1
+    sup._poll_once(slot.restart_at)  # respawn
+    slot.started_at = 11.0
+    assert slot.consecutive_crashes == 1
+    sup._poll_once(12.0)  # alive but not yet healthy_after_s: ladder holds
+    assert slot.consecutive_crashes == 1
+    sup._poll_once(72.0)  # healthy uptime: ladder resets
+    assert slot.consecutive_crashes == 0
+    slot.proc = _FakeProc(returncode=1)  # crash after a healthy hour…
+    sup._poll_once(80.0)
+    assert slot.restart_at == 80.5  # …backs off from BASE, not 2^n
+
+
+def test_supervisor_fake_clock_max_restarts_gives_up():
+    sup, spawned = _fake_clock_supervisor(backoff_base_s=0.5, max_restarts=1)
+    slot = sup._slots[0]
+    slot.proc = _FakeProc(returncode=1)
+    slot.started_at = 0.0
+    sup._poll_once(10.0)
+    sup._poll_once(slot.restart_at)  # restart #1 (the budget)
+    assert spawned == [0]
+    slot.proc = _FakeProc(returncode=1)
+    sup._poll_once(20.0)  # second corpse: budget exhausted
+    assert slot.gave_up
+    sup._poll_once(100.0)  # and STAYS given up — no zombie respawns
+    assert spawned == [0] and sup.restarts_total == 1
+    assert any(
+        e["kind"] == "actor_gave_up" and e.get("actor") == 0
+        for e in get_flight_recorder().events()
+    )
+
+
 def test_supervisor_restarts_crashes_with_backoff():
     argv_fn = lambda i: [  # noqa: E731
         sys.executable, "-c", "import time; time.sleep(0.05); exit(3)",
@@ -584,13 +692,17 @@ def test_supervisor_gives_up_after_max_restarts():
             backoff_base_s=0.02, poll_s=0.02, max_restarts=1
         ),
     )
+    # The flight ring is global across tests (the fake-clock give-up test
+    # above leaves an actor_gave_up behind): only events emitted after OUR
+    # start count.
+    n0 = len(get_flight_recorder().events())
     sup.start()
     try:
         deadline = time.monotonic() + 20
         while time.monotonic() < deadline:
             if any(
                 e["kind"] == "actor_gave_up"
-                for e in get_flight_recorder().events()
+                for e in get_flight_recorder().events()[n0:]
             ):
                 break
             time.sleep(0.05)
@@ -599,7 +711,7 @@ def test_supervisor_gives_up_after_max_restarts():
     assert sup.restarts_total == 1
     assert any(
         e["kind"] == "actor_gave_up"
-        for e in get_flight_recorder().events()
+        for e in get_flight_recorder().events()[n0:]
     )
 
 
@@ -638,6 +750,151 @@ def test_supervisor_gives_up_immediately_on_wire_refusal():
         and e.get("reason") == "wire_refused"
         for e in get_flight_recorder().events()
     )
+
+
+# ------------------------------------------------- learner recovery (ISSUE 7)
+def test_fleet_counters_sidecar_roundtrip_and_prune(tmp_path):
+    """The monotone-counter sidecar: atomic write, typed read, missing ->
+    empty (callers warn), pruned in lockstep with orbax max_to_keep."""
+    from r2d2dpg_tpu.fleet import load_fleet_counters, save_fleet_counters
+    from r2d2dpg_tpu.fleet.ingest import prune_fleet_counters
+
+    d = str(tmp_path)
+    counters = {
+        "drained": 6, "env_steps_total": 1234.0, "param_version": 7,
+        "ep_return_sum": -3.25, "ep_count": 2, "episodes_total": 11,
+    }
+    save_fleet_counters(d, 6, counters)
+    save_fleet_counters(d, 4, {"drained": 4})
+    got = load_fleet_counters(d, 6)
+    assert got == {k: float(v) for k, v in counters.items()}
+    assert load_fleet_counters(d, 99) == {}  # missing: caller warns
+    prune_fleet_counters(d, keep_steps=[6])
+    assert load_fleet_counters(d, 4) == {}
+    assert load_fleet_counters(d, 6)["drained"] == 6.0
+
+
+@pytest.mark.slow
+def test_fleet_learner_checkpoint_resume_in_process(tmp_path):
+    """The learner-recovery contract, end-to-end minus process isolation:
+    run 6 drain phases with periodic checkpoints, abandon the learner
+    (the crash), then resume a FRESH learner+trainer from the checkpoint
+    — it re-enters absorb-to-min_replay (the arena is not checkpointed),
+    completes the TOTAL 10-phase target, and every counter (learner
+    steps, drained phases, env steps, param version) continues monotone
+    from the sidecar."""
+    from r2d2dpg_tpu.fleet import load_fleet_counters
+    from r2d2dpg_tpu.fleet.actor import FleetActor
+    from r2d2dpg_tpu.utils import CheckpointManager
+
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    def fleet_run(n_total, resume):
+        trainer = PENDULUM_TINY.build()
+        learner = FleetLearner(
+            trainer,
+            FleetConfig(num_actors=1, queue_depth=8, idle_timeout_s=120),
+        )
+        address = learner.start()
+        actor = FleetActor(
+            PENDULUM_TINY, actor_id=0, num_actors=1, address=address, seed=0
+        )
+        thread = threading.Thread(
+            target=lambda: _swallow(actor.run, 400), daemon=True
+        )
+        thread.start()
+        ckpt = CheckpointManager(ckpt_dir, save_every=2, light=True)
+        resume_from = None
+        state = None
+        if resume:
+            step = ckpt.latest_step
+            state = trainer.init()
+            import dataclasses as dc
+
+            state = dc.replace(state, train=ckpt.restore(state))
+            resume_from = load_fleet_counters(ckpt_dir, step)
+        try:
+            state = learner.run(
+                n_total,
+                state=state,
+                log_every=0,
+                ckpt=ckpt,
+                checkpoint_every=2,
+                resume_from=resume_from,
+            )
+        finally:
+            learner.close()
+            ckpt.close()
+            thread.join(timeout=30)
+        return trainer, learner, state
+
+    def _swallow(fn, *a):
+        try:
+            fn(*a)
+        except Exception:  # noqa: BLE001 — server teardown cuts the socket
+            pass
+
+    t1, l1, s1 = fleet_run(6, resume=False)
+    c1 = l1.counters()
+    assert c1["drained"] == 6
+    assert int(s1.train.step) == 6 * t1.config.learner_steps
+    step = max(
+        int(p.name[len("fleet_counters_"):-len(".json")])
+        for p in (tmp_path / "ckpt").iterdir()
+        if p.name.startswith("fleet_counters_")
+    )
+    assert step == 6  # the cadence saved at 2, 4, 6 (pruned to keep=3)
+    saved = load_fleet_counters(ckpt_dir, step)
+    assert saved["drained"] == 6 and saved["env_steps_total"] > 0
+
+    t2, l2, s2 = fleet_run(10, resume=True)
+    c2 = l2.counters()
+    # Counters continued, not restarted: the resumed incarnation ran
+    # phases 7..10 and its totals dominate the checkpointed ones.
+    assert c2["drained"] == 10
+    assert int(s2.train.step) == 10 * t2.config.learner_steps
+    assert c2["env_steps_total"] > saved["env_steps_total"]
+    assert c2["param_version"] > saved["param_version"]
+    assert l2.stats()["train_phases"] == 4  # this incarnation's share
+    assert l2.stats()["train_phases_total"] == 10
+
+
+@pytest.mark.slow
+def test_fleet_off_save_resume_determinism_bit_identical(tmp_path):
+    """ISSUE 7's extended anchor: the --actors 0 CLI path stays bitwise
+    identical to the unbroken ``Trainer.run`` ACROSS a save/resume
+    round-trip — train k phases, checkpoint, resume in a fresh process
+    state for the rest, and the final state matches the unbroken run
+    leaf-for-leaf (fleet_gate runs this by its 'determinism' name)."""
+    from r2d2dpg_tpu import train
+    from r2d2dpg_tpu.utils import CheckpointManager
+    from r2d2dpg_tpu.utils.checkpoint import resume_state
+
+    t1 = PENDULUM_TINY.build()
+    warm, fill = t1.window_fill_phases, t1.replay_fill_phases
+    s1 = t1.run(
+        warm + fill + N_TRAIN, log_every=LOG_EVERY, log_fn=lambda *_: None
+    )
+
+    k = 4
+    base = [
+        "--config", "pendulum_tiny",
+        "--actors", "0",
+        "--log-every", str(LOG_EVERY),
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        "--checkpoint-every", "-1",
+        "--watchdog", "0",
+    ]
+    train.run(train.parse_args([*base, "--phases", str(k)]))
+    train.run(
+        train.parse_args([*base, "--phases", str(N_TRAIN - k), "--resume"])
+    )
+    t2 = PENDULUM_TINY.build()
+    s2 = resume_state(
+        t2, CheckpointManager(str(tmp_path / "ckpt"), save_every=-1)
+    )
+    bad = _leaves_equal(s1, s2)
+    assert not bad, f"state diverged at leaves {bad}"
 
 
 # ------------------------------------------------------------ soak (slow)

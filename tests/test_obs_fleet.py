@@ -30,6 +30,7 @@ from r2d2dpg_tpu.fleet.transport import (
     K_HELLO,
     K_SEQS,
     K_TELEM,
+    pack_hello,
     pack_obj,
     recv_frame,
     send_frame,
@@ -64,7 +65,7 @@ def _hello(sock, actor_id):
     send_frame(
         sock,
         K_HELLO,
-        pack_obj(
+        pack_hello(
             {"actor_id": actor_id, **wire.negotiation_fields(wire.WireConfig())}
         ),
     )
