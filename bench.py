@@ -589,9 +589,19 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
         rec["fleet_f32_control"] = fleet_leg(
             actor_counts[-1], WireConfig(), 1
         )
-        # Coalesced schedule probe (drain_coalesce=4, 3 actors): records
-        # width buckets + their compile cost at this box's scale.
+        # Coalesced schedule probe (drain_coalesce=4, 3 actors): the
+        # power-of-two widths are AOT-precompiled by a background thread
+        # during absorb and the pull clamp only admits READY widths
+        # (fleet/ingest.py), so this leg must record sheds=0 — the
+        # ISSUE 9 fix for the mid-run width-compile stalls that shed.
         rec["fleet_coalesce"] = fleet_leg(actor_counts[-1], fast_wire, 4)
+        # Multi-chip learner probe (ISSUE 9): --learner-dp over a forced
+        # 2-virtual-device CPU mesh (subprocess legs), dp=1 vs dp=2 at
+        # equal fleet size, through the full train.py CLI wiring.
+        rec["fleet_learner_dp"] = {
+            "1": _learner_dp_leg(1, phases),
+            "2": _learner_dp_leg(2, phases),
+        }
         top_leg = rec["fleet"][str(actor_counts[-1])]
         top = top_leg["arena_add_seqs_per_sec"]
         rec["value"] = top
@@ -614,13 +624,106 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
             "(vs_f32_wire_seqs), since the learner starves (actor-bound "
             "box), not a seqs/s multiple; fleet_f32_control is the PR 4-"
             "equivalent lane; fleet_coalesce records the drain_coalesce=4 "
-            "schedule; startup shed grace removes the old "
-            "sheds==num_actors warmup artifact"
+            "schedule (ISSUE 9: widths AOT-precompiled during absorb + "
+            "ready-width pull clamp, so mid-run width compiles can no "
+            "longer stall the drain into sheds — NB with the stalls "
+            "gone this starved-learner box forms no queue backlog, so "
+            "coalesce_width_mean ~1 means width>1 never engaged here; "
+            "the width>1 AOT path's correctness evidence is the bitwise "
+            "AOT-vs-jit pin in tests/test_dp_learner.py, and the old "
+            "leg's width_mean 3.62 was itself an artifact of the "
+            "compile stalls creating the backlog); fleet_learner_dp runs "
+            "dp=1 vs dp=2 on 2 FORCED host devices time-slicing this "
+            "container's SINGLE CPU core with 3 actor processes — a "
+            "dp=2 virtual 'chip' adds zero compute here, so dp=2 BELOW "
+            "dp=1 is the expected contention artifact, not a regression; "
+            "the dp speedup claim needs real chips (TPU mesh, or a "
+            "multi-core box via XLA_FLAGS forced devices) and "
+            "learner_dp_gate stamps learner_dp.txt into any such "
+            "evidence dir; vs_baseline is container-relative — PR 5's "
+            "1.1 was recorded on a 2-core box where actor processes "
+            "added real cores, while a single-core container time-slices "
+            "the whole fleet against the one-process baseline, so "
+            "vs_baseline<1 here is the box, not a fleet regression; "
+            "startup shed grace removes the old sheds==num_actors "
+            "warmup artifact"
         )
     except Exception as e:  # noqa: BLE001 — the JSON line is the contract
         rec["value"] = 0.0
         rec["error"] = f"{type(e).__name__}: {e}"[-400:]
     print(json.dumps(rec))
+
+
+def _learner_dp_leg(dp: int, phases: int) -> dict:
+    """One ``--learner-dp`` leg of the fleet probe (ISSUE 9), in a
+    SUBPROCESS: the dp mesh needs ``XLA_FLAGS=
+    --xla_force_host_platform_device_count=2`` set before jax initializes,
+    and forcing virtual devices on the in-process legs would change THEIR
+    XLA runtime mid-comparison.  Both dp legs run under the same forced
+    2-device env (dp=1 on the degenerate mesh), so the dp=2/dp=1 ratio is
+    apples to apples; the probe exercises the real CLI wiring end to end
+    (``--actors 3`` feeding a dp-mesh learner) and parses the end-of-run
+    ``fleet:`` stats line."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("R2D2DPG_PALLAS_INTERPRET", "1")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+    cmd = [
+        sys.executable, "-m", "r2d2dpg_tpu.train",
+        "--config", "pendulum_r2d2", "--num-envs", "64",
+        "--actors", "3", "--learner-dp", str(dp),
+        # The in-process legs' throughput posture (see fleet_leg): park
+        # surplus actors on backpressure rather than shedding and
+        # re-collecting, keep the param device_get off the drain cadence.
+        "--fleet-shed-after", "5", "--fleet-publish-every", "4",
+        "--phases", str(phases), "--log-every", "0",
+    ]
+    try:
+        out = subprocess.run(
+            cmd, env=env, cwd=HERE, capture_output=True, text=True,
+            timeout=900,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "learner-dp leg exceeded 900s"}
+    stats = {}
+    for line in out.stdout.splitlines():
+        # Only the end-of-run stats line — "fleet: ingest on HOST:PORT"
+        # and "fleet: WARNING ..." share the prefix but not the keys.
+        if not line.startswith("fleet: ") or "train_phases" not in line:
+            continue
+        toks = line[len("fleet: "):].split()
+        try:
+            stats = {
+                toks[i]: float(toks[i + 1])
+                for i in range(0, len(toks) - 1, 2)
+            }
+        except ValueError:
+            continue
+    if not stats:
+        return {"error": f"rc={out.returncode}: {out.stderr[-300:]}"}
+    leg = {
+        "learner_steps_per_sec": round(
+            stats.get("train_learner_steps_per_sec", 0.0), 2
+        ),
+        "arena_add_seqs_per_sec": round(
+            stats.get("train_arena_add_seqs_per_sec", 0.0), 2
+        ),
+        "sheds": stats.get("sheds", -1.0),
+        "learner_wait_p99_ms": round(
+            stats.get("learner_wait_p99_ms", 0.0), 1
+        ),
+    }
+    if out.returncode != 0:
+        # The stats line printed but the child died in teardown (final
+        # save, logger close): numbers are real, the run was NOT clean —
+        # the record must say so, not mask it.
+        leg["error"] = f"rc={out.returncode}: {out.stderr[-300:]}"
+    return leg
 
 
 def worker() -> None:

@@ -85,6 +85,33 @@ class ExperimentConfig:
         )
         return R2D2DPG(actor, critic, agent_cfg)
 
+    def build_dp_learner(self, mesh, collect_local: bool) -> Trainer:
+        """Data-parallel LEARNER on ``mesh`` (``--learner-dp N``): replay
+        capacity-sharded + learner batch dp-sharded, pjit style
+        (parallel/dp_learner.py).  ``collect_local`` says this process
+        also collects (``--actors 0``): that in-graph path needs a
+        pure-JAX env — host-pool envs stitch ordered ``io_callback``
+        physics into the phase programs, which the dp learner does not
+        compose with (use HostSPMDTrainer/--spmd for those); under
+        ``--actors N`` the actors own collection and any config works."""
+        env = self.env_factory()
+        if collect_local and getattr(env, "batched", False):
+            raise ValueError(
+                "--learner-dp with --actors 0 requires a pure-JAX env "
+                "config (host-pool envs scale with --spmd / "
+                "HostSPMDTrainer); with --actors N the fleet collects and "
+                "any config works"
+            )
+        if self.trainer.overlap_learner:
+            raise ValueError(
+                "overlap_learner requires a host-pool env trainer "
+                "(HostSPMDTrainer); the dp learner would silently ignore it"
+            )
+        from r2d2dpg_tpu.parallel import DPLearnerTrainer
+
+        agent = self.build_agent(env, axis_name=None)
+        return DPLearnerTrainer(env, agent, self.trainer, mesh)
+
     def build_spmd(self, mesh) -> "Trainer":
         """Multi-chip variant on ``mesh``: pure-JAX envs run whole phases
         under ``shard_map`` (SPMDTrainer); host-pool envs use the pjit-style

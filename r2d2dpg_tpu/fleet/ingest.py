@@ -816,6 +816,19 @@ class IngestServer:
                 pass
 
 
+def aval_tree(tree):
+    """ShapeDtypeStruct tree of ``tree``'s leaves, shardings preserved —
+    the aval capture shared by the drain loop and the coalesce-width
+    precompile (one definition, so the warm-compiled avals can never
+    silently diverge from what the drain loop passes)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(
+            np.shape(x), x.dtype, sharding=getattr(x, "sharding", None)
+        ),
+        tree,
+    )
+
+
 # -------------------------------------------------------- fleet checkpoints
 # The learner-recovery contract (docs/FLEET.md "Failure modes & recovery"):
 # a fleet checkpoint is the LEARNER subtree (params + targets + optimizer
@@ -918,16 +931,41 @@ class FleetLearner:
             warmup_deadline_s=config.warmup_deadline_s,
             auth_token=config.auth_token,
         )
+        drain_kwargs: Dict[str, Any] = {"donate_argnums": (0,)}
+        ls_sh = getattr(trainer, "lstate_shardings", None)
+        if ls_sh is not None:
+            # dp learner (parallel/dp_learner.py): pin the drain outputs
+            # to the init layout so the donated chain's avals stay stable
+            # — neither the jit cache nor the AOT-precompiled coalesce
+            # widths below may re-key mid-run on a GSPMD layout drift.
+            drain_kwargs["out_shardings"] = (ls_sh(), trainer._replicated)
         self._drain_prog = jax.jit(
             lambda ls, st: drain_staged(
                 trainer, ls, st, learn=True, prefetch=config.prefetch
             ),
-            donate_argnums=(0,),
+            **drain_kwargs,
         )
         self._absorb_prog = jax.jit(
             lambda ls, st: drain_staged(trainer, ls, st, learn=False),
-            donate_argnums=(0,),
+            **drain_kwargs,
         )
+        # Coalesce-width precompile (ISSUE 9 satellite — the BENCH_FLEET
+        # coalesce regression): every power-of-two bucket width is a
+        # distinct drain program, and compiling one MID-RUN stalls the
+        # drain for tens of seconds — long enough to fill the queue and
+        # shed.  A background thread AOT-compiles the widths during the
+        # absorb phase (_warm_drain_widths); until a width's program is
+        # READY the pull limit is clamped to the widths that are
+        # (_coalesce_ready), so the drain never blocks on a width compile.
+        self._drain_exec: Dict[int, Any] = {}  # total staged B -> compiled
+        self._coalesce_ready = 1
+        self._warm_thread: Optional[threading.Thread] = None
+        # Set when the run is over: the warm thread checks it between
+        # width compiles, and run()'s finally JOINS the thread — a
+        # daemon mid-XLA-compile at interpreter teardown aborts the
+        # whole process (std::terminate), turning a finished short run
+        # into rc=134.
+        self._warm_stop = threading.Event()
         reg = get_registry()
         self._obs_queue_depth = reg.gauge(
             "r2d2dpg_fleet_staging_queue_depth",
@@ -986,6 +1024,65 @@ class FleetLearner:
         ckpt.save(step, merge_state(state, cstate, lstate))
         save_fleet_counters(ckpt.directory, step, counters)
         prune_fleet_counters(ckpt.directory, ckpt.all_steps())
+
+    def _warm_drain_widths(self, ls_avals, staged_example) -> None:
+        """Background AOT precompile of the power-of-two coalesce widths.
+
+        Runs on a daemon thread started when the FIRST staged batch
+        arrives (its shapes parameterize every width): for each width
+        ``2^k <= drain_coalesce`` the drain-learn program is lowered and
+        compiled against the width's avals — leading-dim-scaled from ONE
+        ``trainer._put_staged`` placement of the example, so the
+        compiled input layout matches what the drain loop will actually
+        pass — and published to ``_drain_exec`` keyed by TOTAL staged B.
+        ``_coalesce_ready`` rises as widths land, in order, so the pull
+        clamp only ever admits a backlog width whose program exists; the
+        drain thread keeps absorbing (tracing is thread-safe; the arena's
+        staged-writer claim is skipped under trace — replay/arena.py).
+        Any failure leaves the clamp at the widths already published
+        (a ``drain_warm_failed`` flight event names it): narrower drains,
+        never a wrong or stalling one."""
+        t = self.trainer
+        try:
+            b0 = int(np.shape(staged_example.seq.reward)[0])
+            # ONE width-1 placement yields the layout (dtype + sharding
+            # per leaf — NamedShardings are shape-agnostic); each width's
+            # avals just scale the leading dim.  No per-width dummy
+            # stacks or device transfers competing with the absorb
+            # phase's real traffic.  A width whose divisibility would
+            # flip the placement decision (b0 not mesh-divisible but
+            # w*b0 is) compiles against the width-1 layout and falls
+            # back through the drain loop's exec_ guard — structural
+            # argv pins b0 divisible fleet-wide, so that is theoretical.
+            base_avals = aval_tree(t._put_staged(staged_example))
+            # w starts at 1: when the FIRST learn pull is coalesced (a
+            # backlog at the absorb->learn crossing dispatches through
+            # the AOT object), the jit wrapper's width-1 cache entry is
+            # never populated — a later width-1 pull would then compile
+            # inline POST-steady, the exact stall this thread removes.
+            w = 1
+            while w <= self.config.drain_coalesce:
+                if self._warm_stop.is_set():
+                    return  # run over: don't start another width compile
+                staged_avals = jax.tree_util.tree_map(
+                    lambda a, _w=w: jax.ShapeDtypeStruct(
+                        (_w * a.shape[0],) + tuple(a.shape[1:]),
+                        a.dtype,
+                        sharding=a.sharding,
+                    ),
+                    base_avals,
+                )
+                compiled = self._drain_prog.lower(
+                    ls_avals, staged_avals
+                ).compile()
+                self._drain_exec[w * b0] = compiled
+                self._coalesce_ready = w
+                flight_event("drain_width_ready", width=w, seqs=w * b0)
+                w *= 2
+        except Exception as e:  # noqa: BLE001 — degrade, never crash the run
+            flight_event(
+                "drain_warm_failed", error=f"{type(e).__name__}: {e}"
+            )
 
     # ------------------------------------------------------------------- run
     def run(
@@ -1052,6 +1149,7 @@ class FleetLearner:
         # program compiles, replay fill) is startup, not sustained rate.
         train_t0: Optional[float] = None
         seqs_at_train_t0 = 0
+        marked_steady = False
 
         def emit_log(phase: int, scalars: Dict[str, float]) -> None:
             if metrics_fn is not None:
@@ -1095,10 +1193,34 @@ class FleetLearner:
                 # into ONE compiled call — the arena-add dispatch is paid
                 # once per backlog instead of once per actor batch.  A
                 # keeping-up learner sees width 1 and the uncoalesced
-                # schedule exactly.
-                msgs = coalesce_from_queue(
-                    self.queue, first, self.config.drain_coalesce
+                # schedule exactly.  The pull is clamped to the widths
+                # whose drain program is READY (precompiled by the warm
+                # thread below): a mid-run width compile stalls the drain
+                # long enough to fill the queue and shed — the
+                # BENCH_FLEET coalesce regression this clamp removes.
+                # Absorb-phase pulls clamp to 1 outright: only the
+                # drain-LEARN widths are warmed, and a wide pull there
+                # would compile an absorb program used for seconds and
+                # never again — the same inline stall in another coat.
+                limit = (
+                    1
+                    if absorbed < min_seqs
+                    else min(self.config.drain_coalesce, self._coalesce_ready)
                 )
+                msgs = coalesce_from_queue(self.queue, first, limit)
+                if self.config.drain_coalesce > 1 and self._warm_thread is None:
+                    # First batch ever: its shapes parameterize every
+                    # coalesce width.  Capture the lstate avals NOW (the
+                    # next drain call donates these buffers) and compile
+                    # the widths in the background while absorb proceeds.
+                    ls_avals = aval_tree(lstate)
+                    self._warm_thread = threading.Thread(
+                        target=self._warm_drain_widths,
+                        args=(ls_avals, msgs[0]["staged"]),
+                        name="fleet-drain-warm",
+                        daemon=True,
+                    )
+                    self._warm_thread.start()
                 coalesce_sum += len(msgs)
                 coalesce_n += 1
                 self._obs_coalesce.set(float(len(msgs)))
@@ -1125,16 +1247,51 @@ class FleetLearner:
                     episodes_total += float(msg.get("ep_count", 0.0))
                     env_steps_total += float(msg.get("env_steps_delta", 0.0))
                 absorbed += n_seqs
+                # Mesh placement BEFORE the compiled call (the dp
+                # learner's _put_staged lays the batch over its dp axis —
+                # jax.make_array_from_process_local_data when
+                # multi-process; identity for single-chip trainers).
+                placed = t._put_staged(staged)
                 # staged_writer around the COMPILED call: inside the jit
                 # the arena's own guard only runs at trace time, so the
                 # single-writer claim must wrap the execution (replay/
                 # arena.py "SINGLE-WRITER contract").
                 if absorbed <= min_seqs:
                     with t.arena.staged_writer():
-                        lstate, _ = self._absorb_prog(lstate, staged)
+                        lstate, _ = self._absorb_prog(lstate, placed)
                     continue
+                exec_ = self._drain_exec.get(n_seqs)
+                note_width = getattr(t, "dp_note_learn_width", None)
+                if note_width is not None:
+                    # The dp learner's dispatch-width gauge, set at the
+                    # REAL drain site (host-known B — no fetch).
+                    note_width(n_seqs)
                 with t.arena.staged_writer():
-                    lstate, last_metrics = self._drain_prog(lstate, staged)
+                    if exec_ is not None:
+                        # AOT-precompiled width (the warm thread's
+                        # contract): dispatch through the compiled object
+                        # — the jit wrapper's cache never saw this width
+                        # and would recompile on it.  An aval mismatch
+                        # (foreign batch structure) raises BEFORE any
+                        # donation, so falling back to the jit path is
+                        # safe — it pays the compile this width's AOT
+                        # object existed to avoid, once, loudly.
+                        try:
+                            lstate, last_metrics = exec_(lstate, placed)
+                        except (TypeError, ValueError) as e:
+                            flight_event(
+                                "drain_exec_fallback",
+                                seqs=n_seqs,
+                                error=f"{type(e).__name__}: {e}",
+                            )
+                            self._drain_exec.pop(n_seqs, None)
+                            lstate, last_metrics = self._drain_prog(
+                                lstate, placed
+                            )
+                    else:
+                        lstate, last_metrics = self._drain_prog(
+                            lstate, placed
+                        )
                 t_dispatch_end = time.time()
                 if traces:
                     # One block_until_ready per SAMPLED drain is what makes
@@ -1184,9 +1341,18 @@ class FleetLearner:
                     jax.block_until_ready(lstate.train.step)
                     train_t0 = time.monotonic()
                     seqs_at_train_t0 = absorbed
-                    # Startup is over: handlers now shed on the real
-                    # shed_after_s bound instead of the compile grace.
+                if not marked_steady and (
+                    self._warm_thread is None
+                    or not self._warm_thread.is_alive()
+                ):
+                    # Startup is over: the first drain-learn has executed
+                    # AND the background coalesce-width compiles (which
+                    # contend for the same cores and would slow the drain
+                    # into queue-full sheds) are done — handlers now shed
+                    # on the real shed_after_s bound instead of the
+                    # compile grace.
                     self.server.mark_steady()
+                    marked_steady = True
                 if phase_fn is not None:
                     # The chaos engine's drain-clock hook (fleet/chaos.py):
                     # learner-boundary faults fire here, between phases.
@@ -1218,9 +1384,15 @@ class FleetLearner:
                     if log_every and drained % log_every == 0:
                         flight_event("param_publish", version=version)
                 if log_every and drained % log_every == 0:
-                    lstep, m = jax.device_get(
-                        (lstate.train.step, last_metrics)
+                    # The dp learner's per-shard gauges ride THIS batched
+                    # fetch (Trainer._log_extra_refs — no fetches of
+                    # their own on the hot path; ISSUE 9 obs satellite).
+                    extra = t._log_extra_refs(lstate.arena)
+                    lstep, m, *extra_vals = jax.device_get(
+                        (lstate.train.step, last_metrics, *extra)
                     )
+                    if extra:
+                        t._log_extra_publish(extra_vals)
                     scalars = {
                         "episode_return_mean": ep_ret_sum / max(ep_count, 1.0),
                         "episodes": ep_count,
@@ -1234,7 +1406,19 @@ class FleetLearner:
                     emit_log(drained, scalars)
         finally:
             jax.block_until_ready(lstate.train.step)
-            wall = max(time.monotonic() - t0, 1e-9)
+            # The run's honest end — BEFORE reaping the warm thread, so
+            # a pending width compile can't inflate the measured walls.
+            t_end = time.monotonic()
+            # Reap the width-precompile thread BEFORE teardown: a daemon
+            # still inside an XLA compile when the interpreter exits
+            # std::terminates the process (observed rc=134 on short
+            # runs).  The stop flag caps the wait at the in-flight
+            # compile; the join itself is unbounded because the thread
+            # always terminates (compile returns or raises).
+            self._warm_stop.set()
+            if self._warm_thread is not None:
+                self._warm_thread.join()
+            wall = max(t_end - t0, 1e-9)
             _, lw_total, lw_p50, lw_p99 = self.learner_wait.snapshot()
             srv = self.server
             # Rates are per-INCARNATION (phases this process ran over this
@@ -1279,7 +1463,7 @@ class FleetLearner:
                 # plain *_per_sec above span the WHOLE run, startup
                 # included — honest for operations, wrong for throughput
                 # comparisons.
-                train_wall = max(time.monotonic() - train_t0, 1e-9)
+                train_wall = max(t_end - train_t0, 1e-9)
                 self._stats["train_wall_s"] = train_wall
                 self._stats["train_arena_add_seqs_per_sec"] = (
                     absorbed - seqs_at_train_t0

@@ -1,12 +1,14 @@
 """SPMD parallelism (SURVEY.md §2.8): dp mesh, sharded replay, ICI psum."""
 
 from r2d2dpg_tpu.parallel import distributed
+from r2d2dpg_tpu.parallel.dp_learner import DPLearnerTrainer
 from r2d2dpg_tpu.parallel.hybrid import HostSPMDTrainer
 from r2d2dpg_tpu.parallel.mesh import DP_AXIS, make_mesh, replicated, sharded
 from r2d2dpg_tpu.parallel.spmd import SPMDTrainer
 
 __all__ = [
     "DP_AXIS",
+    "DPLearnerTrainer",
     "HostSPMDTrainer",
     "SPMDTrainer",
     "distributed",

@@ -278,6 +278,14 @@ class ReplayArena:
                 "add_staged needs resolved priorities; compute them "
                 "(e.g. Trainer._initial_priorities) before absorbing"
             )
+        if isinstance(state.cursor, jax.core.Tracer):
+            # Under a jit trace the claim is meaningless (this body runs at
+            # trace time, not execution time — see the contract above), and
+            # taking it would falsely collide with a drain thread holding
+            # the writer claim around its compiled call while ANOTHER
+            # thread traces a new drain width (the fleet learner's
+            # background coalesce-width precompile, fleet/ingest.py).
+            return self.add(state, staged.seq, staged.priorities)
         with self.staged_writer():
             return self.add(state, staged.seq, staged.priorities)
 
@@ -292,6 +300,22 @@ class ReplayArena:
     # ------------------------------------------------------------------ size
     def size(self, state: ArenaState) -> jnp.ndarray:
         return jnp.minimum(state.total_added, self.capacity)
+
+    def per_shard_occupancy(
+        self, state: ArenaState, num_shards: int
+    ) -> jnp.ndarray:
+        """``[num_shards]`` filled-slot counts by contiguous capacity block.
+
+        The dp-sharded arena's per-shard occupancy (parallel/dp_learner.py):
+        ``NamedSharding(P(DP_AXIS))`` splits axis 0 into equal CONTIGUOUS
+        blocks, so block ``i`` of this reshape is exactly shard ``i``'s
+        slots.  Pure device code — callers fold the result into the obs
+        registry off the log cadence's existing batched ``device_get``."""
+        if self.capacity % num_shards:
+            raise ValueError(
+                f"capacity {self.capacity} not divisible by {num_shards} shards"
+            )
+        return (state.priority.reshape(num_shards, -1) > 0.0).sum(axis=1)
 
     # ---------------------------------------------------------------- sample
     def sample(

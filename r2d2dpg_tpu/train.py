@@ -85,6 +85,14 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="seconds without a staged batch before the learner aborts as "
         "starved (the first batch gets double: actor spawn + compile)"
     )
+    p.add_argument(
+        "--fleet-shed-after", type=float, default=None, metavar="S",
+        help="seconds a queue-full ingest handler waits before shedding a "
+        "staged batch (past the startup grace; default 1.0).  Larger = "
+        "backpressure posture: surplus actors park in the ack wait "
+        "instead of re-collecting shed experience (the bench probes' "
+        "throughput setting); smaller = freshness posture"
+    )
     # Fleet wire fast lane (docs/FLEET.md "Wire format"): one negotiated
     # encoding per fleet; actors are spawned with matching flags.
     p.add_argument(
@@ -161,6 +169,17 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument(
         "--spmd", type=int, default=0, metavar="D",
         help="run under shard_map on a D-device dp mesh (0 = single device)"
+    )
+    p.add_argument(
+        "--learner-dp", type=int, default=0, metavar="D",
+        help="data-parallel LEARNER over a D-device dp mesh "
+        "(parallel/dp_learner.py): replay arena capacity-sharded, learner "
+        "batch dp-sharded, params replicated.  Composes with --actors N "
+        "(the fleet feeds a multi-chip learner — docs/FLEET.md "
+        "'Multi-chip learner') and with --actors 0 (pure-JAX env configs "
+        "only; --learner-dp 1 is pinned bit-identical to the plain "
+        "schedule).  On CPU use XLA_FLAGS="
+        "--xla_force_host_platform_device_count=D.  0 = off"
     )
     # Checkpointing.
     p.add_argument("--checkpoint-dir", default=None)
@@ -330,6 +349,7 @@ def run(args) -> dict:
         or args.chaos_spec is not None
         or args.fleet_token is not None
         or args.fleet_heartbeat is not None
+        or args.fleet_shed_after is not None
     ):
         # The wire/drain fast lane, heartbeat, auth and chaos knobs are
         # properties of the fleet data path; the in-process schedules have
@@ -337,9 +357,28 @@ def run(args) -> dict:
         # (docs/FLEET.md "Mutually exclusive knobs").
         raise SystemExit(
             "--fleet-wire/--fleet-compress/--drain-coalesce/"
-            "--fleet-heartbeat/--fleet-token/--chaos-spec require "
+            "--fleet-heartbeat/--fleet-token/--fleet-shed-after/"
+            "--chaos-spec require "
             "--actors N (the in-process schedules have no fleet wire)"
         )
+    if args.learner_dp:
+        if args.learner_dp < 1:
+            raise SystemExit("--learner-dp must be >= 1 (0 = off)")
+        # The dp learner owns the mesh and the drain/learn layout; knobs
+        # that put ANOTHER owner on the mesh or the phase loop are refused
+        # loudly rather than silently ignored (docs/FLEET.md "Multi-chip
+        # learner" has the matrix).  --actors N composes — that is the
+        # point — and --actors 0 runs the phase-locked loop on the mesh.
+        for flag, bad in (
+            ("--spmd", args.spmd),
+            ("--pipeline 1", args.pipeline),
+            ("--overlap-learner 1", args.overlap_learner),
+        ):
+            if bad:
+                raise SystemExit(
+                    f"--learner-dp does not compose with {flag}; run them "
+                    f"separately (docs/FLEET.md 'Multi-chip learner')"
+                )
     if args.chaos_spec:
         # Validate the grammar up front: a malformed drill schedule must
         # refuse at startup, not after the fleet has spawned.
@@ -381,6 +420,17 @@ def run(args) -> dict:
         from r2d2dpg_tpu.parallel import make_mesh
 
         trainer = cfg.build_spmd(make_mesh(args.spmd))
+    elif args.learner_dp:
+        from r2d2dpg_tpu.parallel import make_mesh
+
+        try:
+            trainer = cfg.build_dp_learner(
+                make_mesh(args.learner_dp), collect_local=not args.actors
+            )
+        except ValueError as e:
+            # Mesh wider than the devices, indivisible capacity/batch, or
+            # a host-pool config under --actors 0: refuse at startup.
+            raise SystemExit(f"--learner-dp: {e}")
     else:
         trainer = cfg.build()
 
@@ -473,6 +523,11 @@ def run(args) -> dict:
         raise SystemExit("--resume requires --checkpoint-dir")
     if args.resume and not args.actors:
         state = resume_state(trainer, ckpt)
+        if hasattr(trainer, "_shardings"):
+            # dp-mesh trainers: restored leaves land single-device; put
+            # them back on the mesh layout or the next jit call sees
+            # inputs spanning mismatched device sets.
+            state = jax.device_put(state, trainer._shardings)
         print(f"resumed from phase {int(state.phase_idx)}", flush=True)
     else:
         # Fleet resume is handled inside _run_fleet: the learner never
@@ -824,6 +879,11 @@ def _run_fleet(
             queue_depth=args.fleet_queue_depth,
             publish_every=args.fleet_publish_every,
             idle_timeout_s=args.fleet_idle_timeout,
+            shed_after_s=(
+                args.fleet_shed_after
+                if args.fleet_shed_after is not None
+                else 1.0
+            ),
             wire=wire_config,
             drain_coalesce=args.drain_coalesce,
             heartbeat_s=heartbeat_s,
@@ -846,6 +906,13 @@ def _run_fleet(
                 f"--resume: no checkpoint found under {args.checkpoint_dir}"
             )
         state = dataclasses.replace(state, train=ckpt.restore(state))
+        if hasattr(trainer, "_shardings"):
+            # dp-mesh learner: the restored train subtree lands
+            # single-device; re-place the state on the mesh layout so the
+            # drain programs' inputs keep one device set (--learner-dp).
+            import jax
+
+            state = jax.device_put(state, trainer._shardings)
         resume_from = load_fleet_counters(args.checkpoint_dir, step)
         if not resume_from:
             print(

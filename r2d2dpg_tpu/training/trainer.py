@@ -185,6 +185,24 @@ class Trainer:
         """Hook: relayout a sampled batch before the learner step."""
         return batch
 
+    def _put_staged(self, staged):
+        """Hook: place a host-side staged batch (numpy leaves) for the
+        drain program.  Identity here — jit's implicit device_put; the
+        dp learner lays the batch out over its mesh instead
+        (parallel/dp_learner.py, the hybrid trainer's ``_put_fleet``
+        idiom), so fleet payloads enter the sharded drain pre-placed."""
+        return staged
+
+    def _log_extra_refs(self, arena_state) -> list:
+        """Hook: extra device refs to ride the log cadence's one batched
+        ``device_get`` (no host syncs of their own).  The dp learner adds
+        its per-shard occupancy vector here."""
+        return []
+
+    def _log_extra_publish(self, fetched) -> None:
+        """Hook: fold the host values of ``_log_extra_refs`` onto the obs
+        registry (called with the fetched tail of the batched get)."""
+
     # ------------------------------------------------------------------ init
     def _env_reset(self, key: jax.Array):
         """Hook: reset the whole fleet (overridden for multi-process pools,
@@ -526,12 +544,15 @@ class Trainer:
         deadlock the SPMD schedule)."""
         refs = [state.completed_count, state.completed_return_sum, state.env_steps]
         single_proc = jax.process_count() == 1
+        extra = []
         if single_proc:
             refs += [
                 self.arena.size(state.arena),
                 state.arena.priority.sum(),
                 state.arena.total_added,
             ]
+            extra = self._log_extra_refs(state.arena)
+            refs += extra
         fetched = jax.device_get(tuple(refs))
         count, ret_sum, env_steps = fetched[:3]
         count = float(count)
@@ -541,10 +562,12 @@ class Trainer:
             "env_steps": float(env_steps),
         }
         if single_proc:
-            occ, psum, added = fetched[3:]
+            occ, psum, added = fetched[3:6]
             self.arena.observe_state_scalars(
                 float(occ), float(psum), float(added)
             )
+            if extra:
+                self._log_extra_publish(fetched[6:])
         self._obs_publish(metrics)
         state = dataclasses.replace(
             state,

@@ -140,6 +140,10 @@ run_evidence() {
         echo "$dir: chaos drill gate FAILED (attempt $attempt)"
         continue
       fi
+      if ! learner_dp_gate "$dir" "$@"; then
+        echo "$dir: learner-dp determinism gate FAILED (attempt $attempt)"
+        continue
+      fi
       timeout --kill-after=30 --signal=TERM 1800 \
         env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
         python -m r2d2dpg_tpu.eval $evalflags \
@@ -172,6 +176,7 @@ pipeline_gate() {
   fi
   if timeout --kill-after=30 900 \
        env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+       XLA_FLAGS= \
        python -m pytest tests/test_pipeline.py -q -p no:cacheprovider \
          -k determinism \
        > "$dir/pipeline_gate.log" 2>&1; then
@@ -223,6 +228,7 @@ fleet_gate() {
   fi
   if timeout --kill-after=30 900 \
        env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+       XLA_FLAGS= \
        python -m pytest tests/test_fleet.py -q -p no:cacheprovider \
          -k determinism \
        > "$dir/fleet_gate.log" 2>&1; then
@@ -253,10 +259,59 @@ chaos_gate() {
   fi
   if timeout --kill-after=30 900 \
        env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+       XLA_FLAGS= \
        python -m pytest tests/test_chaos.py -q -p no:cacheprovider \
          -m 'not slow' \
        > "$dir/chaos_gate.log" 2>&1; then
     touch "$dir/.chaos_drills_ok"
+    return 0
+  fi
+  return 1
+}
+
+# Learner-dp evidence gate (ISSUE 9): a run dir trained with
+# --learner-dp N may only be blessed (.done) if the dp determinism anchor
+# passes on this checkout — proof the dp-mesh layout annotations change
+# no bit of the trajectory before any multi-chip learner number becomes
+# evidence (docs/FLEET.md "Multi-chip learner").  The resolved dp width
+# is stamped into the evidence dir beside fleet_wire.txt either way, so
+# a blessed number always says which mesh produced it.  Same stamping
+# discipline as fleet_gate; non-dp runs pass through untouched.
+#   learner_dp_gate <dir> <train args...>
+learner_dp_gate() {
+  local dir=$1
+  shift
+  local _dp="" _dp_prev=""
+  local _dp_arg
+  for _dp_arg in "$@"; do
+    # Both argparse spellings: "--flag value" and "--flag=value".
+    case "$_dp_arg" in
+      --learner-dp=*) _dp=${_dp_arg#*=} ;;
+    esac
+    case "$_dp_prev" in
+      --learner-dp) _dp=$_dp_arg ;;
+    esac
+    _dp_prev=$_dp_arg
+  done
+  if [ -z "$_dp" ] || [ "$_dp" = 0 ]; then
+    return 0  # not a dp-learner run: nothing to gate
+  fi
+  printf 'learner_dp=%s\n' "$_dp" > "$dir/learner_dp.txt"
+  if [ -f "$dir/.learner_dp_determinism_ok" ]; then
+    return 0
+  fi
+  # NB every gate pytest line clears XLA_FLAGS: a --learner-dp evidence
+  # run exports --xla_force_host_platform_device_count=D, and an
+  # inherited D != 8 fails tests/conftest.py's 8-device assert during
+  # collection — the gate would loop "FAILED" forever on a healthy
+  # anchor.
+  if timeout --kill-after=30 900 \
+       env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+       XLA_FLAGS= \
+       python -m pytest tests/test_dp_learner.py -q -p no:cacheprovider \
+         -k determinism \
+       > "$dir/learner_dp_gate.log" 2>&1; then
+    touch "$dir/.learner_dp_determinism_ok"
     return 0
   fi
   return 1

@@ -141,6 +141,7 @@ def test_train_cli_refuses_wire_flags_without_actors():
         ["--chaos-spec", "kill_actor@p1"],
         ["--fleet-token", "s3cret"],
         ["--fleet-heartbeat", "5"],
+        ["--fleet-shed-after", "5"],
     ):
         args = train.parse_args(["--config", "pendulum_tiny", *flags])
         with pytest.raises(SystemExit, match="require --actors"):

@@ -128,6 +128,67 @@ def test_priority_update_inside_jit():
     )
 
 
+def _dp_arena_state(arena, batch, prios, mesh):
+    """Place a fresh ArenaState on ``mesh`` with the dp-learner layout
+    (data/priority capacity-sharded, cursor/total_added replicated) and
+    add ``batch`` through the jitted staged path."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from r2d2dpg_tpu.parallel.mesh import DP_AXIS
+    from r2d2dpg_tpu.replay.arena import ArenaState, StagedSequences
+
+    dp = NamedSharding(mesh, P(DP_AXIS))
+    rep = NamedSharding(mesh, P())
+    state = jax.device_put(
+        arena.init_state(batch),
+        ArenaState(data=dp, priority=dp, cursor=rep, total_added=rep),
+    )
+    add = jax.jit(arena.add_staged)
+    return add(state, StagedSequences(seq=batch, priorities=prios))
+
+
+def test_dp_sharded_add_staged_and_sample_match_dp1():
+    """ISSUE 9: add_staged + sample on a dp=2 capacity-sharded arena give
+    the SAME indices/probs/priorities as the dp=1 layout at the same seed
+    — sharding is layout, never semantics.  Priorities are small integers
+    so every cumsum association is exact."""
+    from r2d2dpg_tpu.parallel import make_mesh
+
+    arena = ReplayArena(capacity=16, alpha=1.0, use_pallas=False)
+    prios = jnp.array([1.0, 2.0, 3.0, 6.0])
+    key = jax.random.PRNGKey(9)
+    results = {}
+    for d in (1, 2):
+        state = _dp_arena_state(arena, make_batch(4), prios, make_mesh(d))
+        res = jax.jit(arena.sample, static_argnums=2)(state, key, 32)
+        results[d] = jax.device_get(
+            (res.indices, res.probs, state.priority, state.cursor)
+        )
+    for a, b in zip(results[1], results[2]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dp_sharded_arena_layout_and_per_shard_occupancy():
+    """The dp=2 arena's storage really is capacity-sharded, and
+    per_shard_occupancy counts each contiguous capacity block (= shard)."""
+    from jax.sharding import PartitionSpec as P
+
+    from r2d2dpg_tpu.parallel import make_mesh
+    from r2d2dpg_tpu.parallel.mesh import DP_AXIS
+
+    arena = ReplayArena(capacity=8, use_pallas=False)
+    mesh = make_mesh(2)
+    state = _dp_arena_state(arena, make_batch(3), jnp.ones(3), mesh)
+    assert state.priority.sharding.spec == P(DP_AXIS)
+    assert state.data.obs.sharding.spec == P(DP_AXIS)
+    # 3 adds at cursor 0 -> all in shard 0's block (slots 0..3).
+    np.testing.assert_array_equal(
+        np.asarray(arena.per_shard_occupancy(state, 2)), [3, 0]
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        arena.per_shard_occupancy(state, 3)
+
+
 def test_sampled_batch_contents_roundtrip():
     arena = ReplayArena(capacity=16)
     state = arena.init_state(make_batch(4))
