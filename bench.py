@@ -561,8 +561,69 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
             "sheds": s["sheds"],
             "learner_wait_p99_ms": round(s["learner_wait_p99_ms"], 1),
             "bytes_per_seq": round(s["bytes_per_seq"], 1),
+            # Bytes crossing into the TRAINING path per trained sequence
+            # — the central-drain side of the fleet_sampler comparison
+            # (every collected sequence crosses, sampled or not).
+            "bytes_per_trained_seq": round(s["bytes_per_trained_seq"], 1),
             "wire_ratio": round(s["wire_ratio"], 3),
             "coalesce_width_mean": round(s["drain_coalesce_width_mean"], 2),
+        }
+
+    def sampler_leg(
+        num_actors: int, num_shards: int, wire_cfg: "WireConfig"
+    ) -> dict:
+        """One in-network-sampling leg (ISSUE 10, docs/REPLAY.md): same
+        fleet, same wire lane, but replay sharded at the ingest edge and
+        the learner PULLING batches — only sampled sequences cross the
+        sampling boundary into training, so bytes_per_trained_seq is the
+        REQ+BATCH+PRIO cost of exactly the trained draws, not the whole
+        collected stream."""
+        from r2d2dpg_tpu.fleet import SamplerLearner
+
+        trainer = cfg.build()
+        learner = SamplerLearner(
+            trainer,
+            FleetConfig(
+                num_actors=num_actors,
+                publish_every=4,
+                wire=wire_cfg,
+            ),
+            num_shards=num_shards,
+        )
+        address = learner.start()
+        supervisor = ActorSupervisor(
+            lambda i: default_actor_argv(
+                i,
+                config_name=cfg_name,
+                address=address,
+                num_actors=num_actors,
+                seed=cfg.trainer.seed,
+                extra=[
+                    "--num-envs", str(n_envs),
+                    "--wire", wire_cfg.encoding,
+                    "--compress", wire_cfg.compress,
+                ],
+            ),
+            num_actors,
+        )
+        try:
+            supervisor.start()
+            learner.run(phases, log_every=0)
+        finally:
+            supervisor.stop()
+            learner.close()
+        s = learner.stats()
+        return {
+            "learner_steps_per_sec": round(
+                s.get("train_learner_steps_per_sec", 0.0), 2
+            ),
+            "sheds": s["sheds"],  # structurally 0: ring eviction, no queue
+            "trained_seqs": s["trained_seqs"],
+            "collected_seqs": s["collected_seqs"],
+            "bytes_per_trained_seq": round(s["bytes_per_trained_seq"], 1),
+            "sample_bytes_total": round(s["sample_bytes_total"], 0),
+            "replay_occupancy": s["replay_occupancy"],
+            "sampler_wait_p99_ms": round(s["sampler_wait_p99_ms"], 1),
         }
 
     rec = {
@@ -595,6 +656,19 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
         # (fleet/ingest.py), so this leg must record sheds=0 — the
         # ISSUE 9 fix for the mid-run width-compile stalls that shed.
         rec["fleet_coalesce"] = fleet_leg(actor_counts[-1], fast_wire, 4)
+        # In-network sampling probe (ISSUE 10): same 3-actor fleet and
+        # fast lane, replay sharded at the ingest edge (2 shards: the
+        # config's capacity must split evenly; 3 would be refused on
+        # indivisibility), learner-pulled batches.  The
+        # headline is bytes_per_trained_seq vs the central-drain leg —
+        # only sampled sequences cross the sampling boundary — at
+        # sheds=0 on BOTH sides (the sampler's are structural).
+        rec["fleet_sampler"] = sampler_leg(actor_counts[-1], 2, fast_wire)
+        rec["sampler_bytes_reduction_vs_central"] = round(
+            rec["fleet"][str(actor_counts[-1])]["bytes_per_trained_seq"]
+            / max(rec["fleet_sampler"]["bytes_per_trained_seq"], 1e-9),
+            2,
+        )
         # Multi-chip learner probe (ISSUE 9): --learner-dp over a forced
         # 2-virtual-device CPU mesh (subprocess legs), dp=1 vs dp=2 at
         # equal fleet size, through the full train.py CLI wiring.
@@ -646,7 +720,15 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
             "the whole fleet against the one-process baseline, so "
             "vs_baseline<1 here is the box, not a fleet regression; "
             "startup shed grace removes the old sheds==num_actors "
-            "warmup artifact"
+            "warmup artifact; fleet_sampler (ISSUE 10) runs the same "
+            "3-actor fleet with --replay-shards 2 in-network sampling — "
+            "its bytes_per_trained_seq counts the SAMPLE_REQ/BATCH/PRIO "
+            "frames of exactly the trained draws (the central leg's "
+            "counts every collected+absorbed sequence, fill included), "
+            "sampler_bytes_reduction_vs_central is the headline 'only "
+            "sampled sequences cross' ratio, and its learner free-runs "
+            "(pull-paced, not arrival-paced) so steps/s is not "
+            "comparable to the drain legs' arrival-paced rate"
         )
     except Exception as e:  # noqa: BLE001 — the JSON line is the contract
         rec["value"] = 0.0

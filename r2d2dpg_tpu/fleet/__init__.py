@@ -17,6 +17,9 @@ tests/test_fleet.py.
   ladder slice (``python -m r2d2dpg_tpu.fleet.actor``).
 - ``ingest``     — ``IngestServer`` (N connections -> staging queue) and
   ``FleetLearner`` (the queue's single consumer: drain -> add -> learn).
+- ``sampler``    — in-network experience sampling (``--replay-shards N``,
+  ISSUE 10): replay sharded at the ingest edge, learner-pulled batches
+  over SAMPLE_REQ/BATCH/PRIO frames (docs/REPLAY.md).
 - ``supervisor`` — spawn/monitor/restart-with-backoff for the actor
   subprocesses; crashes land in the flight recorder.
 - ``chaos``      — seeded fault-injection drills at the fleet's real
@@ -35,6 +38,11 @@ from r2d2dpg_tpu.fleet.ingest import (
     load_fleet_counters,
     save_fleet_counters,
 )
+from r2d2dpg_tpu.fleet.sampler import (
+    SamplerLearner,
+    ShardSet,
+    shard_for_actor,
+)
 from r2d2dpg_tpu.fleet.supervisor import (
     ActorSupervisor,
     SupervisorConfig,
@@ -49,10 +57,13 @@ __all__ = [
     "FleetConfig",
     "FleetLearner",
     "IngestServer",
+    "SamplerLearner",
+    "ShardSet",
     "SupervisorConfig",
     "WireConfig",
     "default_actor_argv",
     "load_fleet_counters",
     "parse_chaos_spec",
     "save_fleet_counters",
+    "shard_for_actor",
 ]

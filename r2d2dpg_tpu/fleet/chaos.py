@@ -67,9 +67,25 @@ from r2d2dpg_tpu.obs import flight_event, get_flight_recorder, get_registry
 
 # Faults injected from the learner process (its drain-phase clock) vs from
 # inside the target actor process (its emitted-batch clock).
-LEARNER_FAULTS = frozenset({"kill_actor", "kill_ingest_conn"})
+# ``kill_sampler_conn``/``stall_sampler`` drill the in-network-sampling
+# peer class (fleet/sampler.py, ISSUE 10): the first drops the connection
+# FEEDING the target actor's replay shard (recovery: actor reconnect with
+# at-least-once accounting — a dead shard feed loses only re-collectable
+# experience, never step/episode sums); the second stalls the sampler
+# learner's own pull loop for its duration (recovery: nothing to recover —
+# shards keep absorbing under their own locks and ring-evict instead of
+# shedding, which is exactly the property the drill pins).
+LEARNER_FAULTS = frozenset(
+    {"kill_actor", "kill_ingest_conn", "kill_sampler_conn", "stall_sampler"}
+)
 ACTOR_FAULTS = frozenset({"stall_actor", "corrupt_frame"})
+# The sampler peer class: train.py refuses these without --replay-shards
+# (on the central drain a "sampler stall" would stall the DRAIN thread
+# and shed — evidence for an invariant that path cannot exhibit).
+SAMPLER_FAULTS = frozenset({"kill_sampler_conn", "stall_sampler"})
 FAULT_KINDS = tuple(sorted(LEARNER_FAULTS | ACTOR_FAULTS))
+# Faults that carry (and require) a :Ds duration suffix.
+STALL_FAULTS = frozenset({"stall_actor", "stall_sampler"})
 
 _FAULT_RE = re.compile(
     r"^(?P<kind>[a-z_]+)@p(?P<phase>\d+)(?::(?P<dur>\d+(?:\.\d+)?)s)?$"
@@ -111,14 +127,15 @@ def parse_chaos_spec(spec: str) -> Tuple[Fault, ...]:
         if phase < 1:
             raise ValueError(f"chaos fault {token!r}: phase must be >= 1")
         dur = float(m.group("dur") or 0.0)
-        if dur and kind != "stall_actor":
+        if dur and kind not in STALL_FAULTS:
             raise ValueError(
-                f"chaos fault {token!r}: only stall_actor takes a duration"
+                f"chaos fault {token!r}: only {sorted(STALL_FAULTS)} take "
+                f"a duration"
             )
-        if kind == "stall_actor" and dur <= 0.0:
+        if kind in STALL_FAULTS and dur <= 0.0:
             raise ValueError(
-                f"chaos fault {token!r}: stall_actor needs a duration "
-                f"(e.g. stall_actor@p5:4s)"
+                f"chaos fault {token!r}: {kind} needs a duration "
+                f"(e.g. {kind}@p5:4s)"
             )
         faults.append(Fault(kind=kind, phase=phase, duration_s=dur, index=i))
     return tuple(faults)
@@ -241,7 +258,13 @@ class ChaosEngine:
                     continue
                 self._fired.add(fault.index)
                 record_injection(fault, target, at_phase=phase)
-            elif fault.kind == "kill_ingest_conn":
+            elif fault.kind in ("kill_ingest_conn", "kill_sampler_conn"):
+                # kill_sampler_conn shares the boundary (a learner-side
+                # socket close) but names the SAMPLER peer class: the
+                # dropped connection is the one feeding the target
+                # actor's replay shard — the drill asserts the shard's
+                # DATA survives and only the in-flight batch (plus its
+                # re-banked accounting) is lost (tests/test_chaos.py).
                 dropped = (
                     self.server.drop_connection(actor=str(target))
                     if self.server is not None
@@ -253,6 +276,16 @@ class ChaosEngine:
                 record_injection(
                     fault, target, at_phase=phase, dropped=dropped
                 )
+            elif fault.kind == "stall_sampler":
+                # The stall IS the fault: the pull loop (this thread)
+                # stops sampling for the duration.  Recorded BEFORE the
+                # sleep so evidence survives however the drill ends.
+                self._fired.add(fault.index)
+                record_injection(
+                    fault, target, at_phase=phase,
+                    duration_s=fault.duration_s,
+                )
+                time.sleep(fault.duration_s)
 
     def unfired(self) -> Tuple[Fault, ...]:
         """Learner-side faults whose phase never arrived (run too short):

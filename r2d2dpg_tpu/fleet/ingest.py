@@ -144,8 +144,16 @@ class IngestServer:
         read_deadline_s: float = transport.READ_DEADLINE_S,
         warmup_deadline_s: float = 120.0,
         auth_token: Optional[str] = None,
+        shards=None,
     ):
         self.queue = staging_queue
+        # In-network sampling (fleet/sampler.py, ISSUE 10): when a
+        # ``ShardSet`` is given, SEQS batches bypass the staging queue —
+        # each handler writes straight into its actor's replay shard
+        # (consistent-hash routing assigned at HELLO) under that shard's
+        # own lock, so N handlers add concurrently and NOTHING sheds
+        # (a full shard ring FIFO-evicts re-collectable experience).
+        self.shards = shards
         self._request_address = address
         self.shed_after_s = shed_after_s
         self.startup_shed_grace_s = startup_shed_grace_s
@@ -670,6 +678,11 @@ class IngestServer:
             # Accepted actor: staleness is visible from THIS moment, not
             # from its first well-formed TELEM (which may never come).
             self._arm_telem_staleness(actor)
+            # Sharded-replay routing is per ACTOR ID, not per connection:
+            # a reconnecting incarnation keeps feeding the same shard.
+            shard_id = (
+                self.shards.route(actor) if self.shards is not None else None
+            )
             sent_version = self._push_params_if_stale(conn, 0, bytes_out)
             bytes_out.inc(
                 send_frame(
@@ -718,6 +731,13 @@ class IngestServer:
                 msg = unpacker.unpack(payload)
                 t_decode_end = time.time()
                 tr = unpacker.last_trace
+                if tr is not None and self.shards is not None:
+                    # Sharded mode: the SEQS sidecar's hop chain has no
+                    # completing drain to record it (the sampler path
+                    # traces sample_req -> batch_return -> learn
+                    # instead) — drop it rather than leave a partial
+                    # chain (the all-or-nothing contract, obs/trace.py).
+                    tr = None
                 if tr is not None:
                     # The sampled batch's actor-side hops (off the wire
                     # sidecar) + this handler's transit/decode timestamps
@@ -753,7 +773,16 @@ class IngestServer:
                     self.seqs_received_total += n_seqs
                     self.seqs_bytes_total += HEADER_BYTES + len(payload)
                     self.seqs_raw_bytes_total += unpacker.last_raw_len
-                if self._put_or_shed(msg):
+                if self.shards is not None:
+                    # In-network sampling: straight into this actor's
+                    # shard — concurrent across handlers, never sheds
+                    # (ring eviction is the backpressure), accounting
+                    # deltas banked for the sampler learner's sums.
+                    self.shards.add(shard_id, msg)
+                    code = OK
+                    with self._lock:
+                        self.seqs_total += n_seqs
+                elif self._put_or_shed(msg):
                     code = OK
                     with self._lock:  # N handler threads share these sums
                         self.seqs_total += n_seqs
@@ -1451,6 +1480,20 @@ class FleetLearner:
                 "bytes_per_seq": (
                     srv.seqs_bytes_total / max(srv.seqs_received_total, 1)
                 ),
+                # Bytes crossing into the TRAINING path per trained
+                # sequence: under the central drain, EVERY collected
+                # sequence crosses the wire into the arena whether or not
+                # it is ever sampled — the in-network sampler's headline
+                # comparison (bench.py fleet_sampler; docs/REPLAY.md).
+                "bytes_per_trained_seq": (
+                    srv.seqs_bytes_total
+                    / max(
+                        drained_here
+                        * t.config.learner_steps
+                        * t.config.batch_size,
+                        1,
+                    )
+                ),
                 "wire_ratio": (
                     srv.seqs_raw_bytes_total / max(srv.seqs_bytes_total, 1)
                 ),
@@ -1482,17 +1525,22 @@ class FleetLearner:
         )
 
     def _snapshot_params(self, lstate: LearnerState) -> Any:
-        """The published snapshot: everything an actor needs to act AND to
-        rank fresh sequences locally (``agent.initial_priority`` burns in
-        online + target nets of both cores — Ape-X actors rank with their
-        stale copies of all four)."""
-        train = lstate.train
-        return to_host(
-            {
-                "actor_params": train.actor_params,
-                "critic_params": train.critic_params,
-                "target_actor_params": train.target_actor_params,
-                "target_critic_params": train.target_critic_params,
-                "step": train.step,
-            }
-        )
+        return snapshot_params(lstate.train)
+
+
+def snapshot_params(train) -> Any:
+    """The published snapshot: everything an actor needs to act AND to
+    rank fresh sequences locally (``agent.initial_priority`` burns in
+    online + target nets of both cores — Ape-X actors rank with their
+    stale copies of all four).  ONE definition for both learners (the
+    central ``FleetLearner`` and the sampler's ``SamplerLearner``): a
+    published field added here reaches every fleet flavor."""
+    return to_host(
+        {
+            "actor_params": train.actor_params,
+            "critic_params": train.critic_params,
+            "target_actor_params": train.target_actor_params,
+            "target_critic_params": train.target_critic_params,
+            "step": train.step,
+        }
+    )

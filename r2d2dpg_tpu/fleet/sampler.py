@@ -1,0 +1,756 @@
+"""In-network experience sampling: sharded replay, learner-pulled batches.
+
+The central-drain fleet (``fleet/ingest.py``) funnels EVERY collected
+sequence through one staging queue into one device arena behind one drain
+thread — the wire and the drain both carry experience that may never be
+sampled.  This module inverts the topology (ISSUE 10; In-Network
+Experience Sampling, PAPERS.md 2110.13506; Ape-X distributed replay,
+1803.00933):
+
+::
+
+    actor 0 ── SEQS ──▶ handler ──▶ [shard 0]  priority structure + ring
+    actor 1 ── SEQS ──▶ handler ──▶ [shard 1]      (replay/sharded.py)
+    actor … ── SEQS ──▶ handler ──▶ [shard h(actor) mod N]
+                                        ▲ │
+                     SAMPLE_REQ {quota} ─┘ │ BATCH {seqs, slots/gens,
+                     PRIO {slot, gen, p}◀──┘        probs, Σp^α}
+                                      sampler learner:
+                                      quotas ∝ Σp^α → K·B draws →
+                                      learn program → TD write-back
+
+- **Adds are concurrent**: each ingest handler writes straight into its
+  actor's shard (consistent-hash ``shard_for_actor`` routing assigned at
+  HELLO) under that shard's own lock — the central drain thread stops
+  being a serialization point, and replay capacity is a per-shard slice
+  (horizontal, not one device ring).
+- **The learner pulls**: each train phase draws per-shard quotas from a
+  multinomial over the shards' advertised ``Σ p^alpha``
+  (``replay.sharded.shard_quotas``), samples within-shard
+  proportionally, and learns on the assembled ``[K, B]`` batch with
+  importance weights computed from the COMBINED two-level probabilities —
+  exactly the central proportional distribution
+  (tests/test_replay.py pins this on exact-integer priorities).
+- **Priority write-back rides the versioned path in reverse**: PRIO
+  frames keyed ``(shard, slot, generation)``; a slot the ring has
+  evicted since the sample ignores the stale verdict, the same posture
+  as the actors' param-version regression guard.
+- **Backpressure becomes ring eviction**: shards never shed — a full ring
+  FIFO-overwrites its oldest (re-collectable) sequences, so actor acks
+  are always ``OK`` and a stalled learner never sheds or reaps a healthy
+  fleet (the ``stall_sampler`` chaos drill pins this).
+
+**Deployment shape**: the shards run as in-learner handlers behind
+``--replay-shards N`` today, but every sample/write-back crosses the REAL
+``SAMPLE_REQ``/``BATCH``/``PRIO`` frame codecs (``fleet/wire.py``
+``pack_sample_req``/``pack_shard_batch``/``pack_prio_update``, on the
+fleet's negotiated lane) through an in-process loopback — the byte
+accounting is the honest cross-process cost, and moving a shard out of
+the learner process is a listening socket away, not a format change
+(docs/REPLAY.md "Topology").  The headline this buys: only SAMPLED
+sequences cross the sampling boundary into training
+(``bytes_per_trained_seq`` — ``bench.py fleet_sampler``).
+
+``--replay-shards 1 --actors 0`` routes the untouched phase-locked loop
+(nothing to shard without a fleet) and is pinned bit-identical to
+``Trainer.run`` through the CLI — ``scripts/lib_gate.sh sampler_gate``
+refuses to bless ``--replay-shards N`` evidence without that anchor plus
+the sampling-equivalence test.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from r2d2dpg_tpu.fleet import transport, wire
+from r2d2dpg_tpu.fleet.ingest import (
+    FleetConfig,
+    IngestServer,
+    prune_fleet_counters,
+    save_fleet_counters,
+    snapshot_params,
+)
+from r2d2dpg_tpu.obs import flight_event, get_registry
+from r2d2dpg_tpu.obs import trace as obs_trace
+from r2d2dpg_tpu.ops import anneal_beta, importance_weights
+from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
+from r2d2dpg_tpu.replay.sharded import (
+    ReplayShard,
+    combine_probs,
+    shard_quotas,
+)
+from r2d2dpg_tpu.training.pipeline import merge_state, split_state
+from r2d2dpg_tpu.training.trainer import Trainer, TrainerState
+
+
+def shard_for_actor(actor_id: Any, num_shards: int) -> int:
+    """Consistent actor→shard routing, assigned at HELLO.
+
+    A pure function of the actor id (not the connection), so a
+    supervised restart or an in-process reconnect lands the SAME actor
+    back on the SAME shard — its slice of replay keeps one feed across
+    incarnations, and every process (ingest, tests, a future cross-host
+    spawner) computes the route identically with no coordination.
+    Integer ids (the supervisor's 0..N-1) route round-robin by modulo —
+    perfect balance at fleet sizes where a generic hash would collide —
+    and any other id falls back to a crc32 consistent hash."""
+    s = str(actor_id)
+    if s.lstrip("-").isdigit():
+        return int(s) % max(num_shards, 1)
+    return zlib.crc32(s.encode()) % max(num_shards, 1)
+
+
+class ShardSet:
+    """N replay shards + routing + the fleet-side accounting bank.
+
+    Owned by the sampler learner, written by the ingest handler threads
+    (``add`` routes each actor's SEQS batch into its shard under that
+    shard's lock).  Episode/step accounting deltas ride the same bank the
+    central path uses for shed stats: the experience goes to a shard, the
+    ACCOUNTING goes to the learner (popped once per train phase), so the
+    fleet-wide sums stay monotone whatever the sampler is doing."""
+
+    def __init__(
+        self,
+        num_shards: int,
+        shard_capacity: int,
+        *,
+        alpha: float = 0.6,
+        prioritized: bool = True,
+    ):
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.shards = [
+            ReplayShard(
+                shard_capacity,
+                alpha=alpha,
+                prioritized=prioritized,
+                shard_id=i,
+            )
+            for i in range(num_shards)
+        ]
+        self._stats_lock = threading.Lock()
+        self._stats = {
+            "env_steps_delta": 0.0, "ep_return_sum": 0.0, "ep_count": 0.0,
+        }
+        # Per-shard gauges (ISSUE 10 obs satellite): the shards are
+        # host-side, so the values are lock-guarded floats — set_fn
+        # closures evaluated at scrape/log time, NO device fetch rides
+        # anywhere (cheaper than the central arena's gauges, which need
+        # the log cadence's batched device_get).
+        reg = get_registry()
+        psum = reg.gauge(
+            "r2d2dpg_replay_shard_priority_sum",
+            "raw priority sum of one replay shard (the quota weight is "
+            "sum p^alpha — ReplayShard.scaled_sum)",
+            labelnames=("shard",),
+        )
+        occ = reg.gauge(
+            "r2d2dpg_replay_shard_occupancy",
+            "filled slots of one replay shard",
+            labelnames=("shard",),
+        )
+        for i, s in enumerate(self.shards):
+            psum.labels(shard=str(i)).set_fn(s.priority_sum)
+            occ.labels(shard=str(i)).set_fn(s.occupancy)
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.shards)
+
+    def route(self, actor_id: Any) -> int:
+        return shard_for_actor(actor_id, len(self.shards))
+
+    def add(self, shard_id: int, msg: Dict[str, Any]) -> int:
+        """One SEQS message into its shard (handler-thread side): the
+        staged sequences enter the ring (None priorities resolve to the
+        shard's max — the central "max" entry semantics), the accounting
+        deltas enter the bank.  Never sheds: a full ring FIFO-evicts."""
+        staged: StagedSequences = msg["staged"]
+        n = self.shards[shard_id].add(staged.seq, staged.priorities)
+        with self._stats_lock:
+            for k in self._stats:
+                self._stats[k] += float(msg.get(k, 0.0))
+        return n
+
+    def pop_stats(self) -> Dict[str, float]:
+        with self._stats_lock:
+            out = dict(self._stats)
+            for k in self._stats:
+                self._stats[k] = 0.0
+        return out
+
+    def occupancy_total(self) -> int:
+        return sum(s.occupancy() for s in self.shards)
+
+    def scaled_sums(self) -> np.ndarray:
+        return np.asarray([s.scaled_sum() for s in self.shards], np.float64)
+
+
+class SamplerLearner:
+    """The learner side of in-network sampling (``--replay-shards N``).
+
+    Mirrors ``FleetLearner``'s lifecycle (start/run/close, counters,
+    checkpoint sidecar, param publication, chaos ``phase_fn`` hook) but
+    replaces the drain loop with a PULL loop: no staging queue, no device
+    arena on the hot path — each train phase assembles ``K`` batches of
+    ``batch_size`` from the shards through the SAMPLE_REQ/BATCH loopback
+    codecs and runs one compiled K-update program on them, then writes
+    TD priorities back through PRIO frames.
+
+    The learner free-runs at its own pace (the Ape-X relation): phases
+    are not arrival-paced, so the data-to-update ratio floats with the
+    collection/consumption balance — a *different, equally valid*
+    trajectory class than the phase-locked schedule, like the fleet
+    itself (docs/REPLAY.md "Pacing").
+    """
+
+    def __init__(
+        self,
+        trainer: Trainer,
+        config: FleetConfig,
+        *,
+        num_shards: int,
+        total_capacity: Optional[int] = None,
+    ):
+        if trainer.axis is not None:
+            raise ValueError(
+                "SamplerLearner needs a host-visible learn boundary; "
+                "shard_map trainers fuse whole phases — use the base "
+                "Trainer"
+            )
+        if getattr(trainer, "lstate_shardings", None) is not None:
+            raise ValueError(
+                "--replay-shards does not compose with --learner-dp: the "
+                "dp learner shards the DEVICE arena the sampler path "
+                "bypasses (docs/REPLAY.md 'Refused knobs')"
+            )
+        if config.num_actors < 1:
+            raise ValueError(
+                "SamplerLearner requires num_actors >= 1 (replay shards "
+                "are fed by actor SEQS traffic)"
+            )
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        if config.drain_coalesce != 1:
+            raise ValueError(
+                "--drain-coalesce shapes the central drain the sampler "
+                "path replaces; it does not compose with --replay-shards"
+            )
+        # The shards own the REPLAY capacity; train.py shrinks the
+        # trainer's (unused) device arena in sampler mode and passes the
+        # experiment's real capacity here instead.
+        cap = (
+            int(total_capacity)
+            if total_capacity is not None
+            else trainer.config.capacity
+        )
+        if cap % num_shards:
+            raise ValueError(
+                f"replay capacity {cap} not divisible by {num_shards} "
+                f"shards (each shard owns an equal slice)"
+            )
+        config.wire.validate()
+        self.trainer = trainer
+        self.config = config
+        self.num_shards = num_shards
+        self.shards = ShardSet(
+            num_shards,
+            cap // num_shards,
+            alpha=trainer.config.priority_alpha,
+            prioritized=trainer.config.prioritized,
+        )
+        # The ingest server routes SEQS straight into the shards; its
+        # staging queue exists only structurally (nothing ever enqueues,
+        # so nothing can shed — ring eviction is the backpressure).
+        self.queue: "queue.Queue" = queue.Queue(maxsize=config.queue_depth)
+        self.server = IngestServer(
+            self.queue,
+            address=config.address,
+            shed_after_s=config.shed_after_s,
+            startup_shed_grace_s=config.startup_shed_grace_s,
+            max_frame_bytes=config.max_frame_bytes,
+            wire_config=config.wire,
+            read_deadline_s=config.heartbeat_s,
+            warmup_deadline_s=config.warmup_deadline_s,
+            auth_token=config.auth_token,
+            shards=self.shards,
+        )
+        # Loopback frame codecs, one packer/unpacker pair per direction
+        # (the sampler loop is the only caller — single-threaded).  The
+        # negotiated fleet lane applies, so the counted bytes are exactly
+        # what a cross-process shard would put on a real socket; on the
+        # default f32/none lane the roundtrip is bit-exact.
+        self._req_packer = wire.TreePacker(
+            config.wire, max_frame_bytes=config.max_frame_bytes
+        )
+        self._req_unpacker = wire.TreeUnpacker(
+            max_frame_bytes=config.max_frame_bytes
+        )
+        self._batch_packer = wire.TreePacker(
+            config.wire, max_frame_bytes=config.max_frame_bytes
+        )
+        self._batch_unpacker = wire.TreeUnpacker(
+            max_frame_bytes=config.max_frame_bytes
+        )
+        self._learn_prog = jax.jit(self._learn_impl, donate_argnums=(0,))
+        self._req_id = 0
+        self.sample_bytes_total = 0  # SAMPLE_REQ + BATCH + PRIO, with headers
+        self.trained_seqs_total = 0
+        reg = get_registry()
+        self.sampler_wait = reg.histogram(
+            "r2d2dpg_sampler_wait_seconds",
+            "sampler learner blocked waiting for shard occupancy "
+            "(absorb-to-min_replay and any refill stall)",
+        )
+        self.sample_assemble = reg.histogram(
+            "r2d2dpg_sampler_sample_seconds",
+            "one phase's SAMPLE_REQ -> stacked-batch assembly (pack, "
+            "shard draws, decode, stack)",
+        )
+        self._obs_trained = reg.counter(
+            "r2d2dpg_sampler_trained_seqs_total",
+            "sequences pulled across the sampling boundary into training",
+        )
+        self._obs_bytes = reg.counter(
+            "r2d2dpg_sampler_bytes_total",
+            "bytes crossing the sampling boundary (SAMPLE_REQ + BATCH + "
+            "PRIO frames, headers included)",
+        )
+        self._stats: Dict[str, float] = {}
+        self._counters: Dict[str, float] = {}
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> str:
+        self.server.start()
+        return self.server.connect_address
+
+    def close(self) -> None:
+        self.server.stop()
+
+    def stats(self) -> Dict[str, float]:
+        return dict(self._stats)
+
+    def counters(self) -> Dict[str, float]:
+        return dict(self._counters)
+
+    # ------------------------------------------------------- device program
+    def _learn_impl(self, train, seqs: SequenceBatch, probs, size, key):
+        """K importance-weighted updates on pulled batches.
+
+        ``seqs`` leaves are ``[K, B, ...]``, ``probs`` the COMBINED
+        two-level probabilities ``[K, B]``, ``size`` the fleet-wide
+        occupancy (the N of the IS correction).  Same anneal / weight /
+        smoothing-key semantics as ``Trainer._update_step`` — only the
+        sample source moved; there is no arena scatter because priorities
+        ride back to the shards host-side."""
+        t = self.trainer
+        cfg = t.config
+        keys = jax.random.split(key, cfg.learner_steps)
+
+        def one(train, inp):
+            batch, p, k = inp
+            kl = jax.random.fold_in(k, 1)
+            if cfg.prioritized:
+                beta = anneal_beta(
+                    train.step, beta0=cfg.beta0, steps=cfg.beta_steps
+                )
+                w = importance_weights(p, size, beta=beta)
+            else:
+                w = jnp.ones((cfg.batch_size,))
+            train, prios, metrics = t.agent.learner_step(
+                train, t._reshard_batch(batch), w, key=kl
+            )
+            return train, (prios, metrics)
+
+        train, (prios, metrics) = lax.scan(one, train, (seqs, probs, keys))
+        metrics = jax.tree_util.tree_map(lambda m: m.mean(), metrics)
+        return train, prios, metrics
+
+    # ------------------------------------------------------- sample assembly
+    def _roundtrip(self, unpacker, parts) -> Any:
+        """One loopback frame (already packed ``parts``): count its
+        honest wire bytes (header included), decode through the real
+        unpacker.  This IS the cross-process hot path minus the
+        socket."""
+        payload = b"".join(bytes(p) for p in parts)
+        n = transport.HEADER_BYTES + len(payload)
+        self.sample_bytes_total += n
+        self._obs_bytes.inc(n)
+        return unpacker.unpack(payload)
+
+    def _pull_phase_batches(self, n_draws: int, rng: np.random.Generator):
+        """One phase's two-level pull: quotas ∝ advertised Σp^α, one
+        SAMPLE_REQ/BATCH exchange per non-empty shard, PRIO handles and
+        combined probabilities assembled for the learn program.
+
+        Returns ``(seq [n,...], probs [n], handles, occupancy_total)``
+        with the concatenated draws PERMUTED (seeded) before the caller
+        reshapes to ``[K, B]`` — quota counts are per shard, and without
+        the shuffle update k would correlate with shard identity."""
+        sums = self.shards.scaled_sums()
+        quotas = shard_quotas(sums, n_draws, rng)
+        total = float(sums.sum())
+        seqs: List[SequenceBatch] = []
+        probs: List[np.ndarray] = []
+        handles: List[tuple] = []  # (shard, slots, gens) per response
+        for shard_id, quota in enumerate(quotas):
+            if quota == 0:
+                continue
+            self._req_id += 1
+            req = wire.unpack_sample_req(
+                self._roundtrip(
+                    self._req_unpacker,
+                    wire.pack_sample_req(
+                        self._req_packer,
+                        req_id=self._req_id,
+                        shard=shard_id,
+                        quota=int(quota),
+                    ),
+                )
+            )
+            shard = self.shards.shards[req["shard"]]
+            s = shard.sample(req["quota"], rng)
+            resp = wire.unpack_shard_batch(
+                self._roundtrip(
+                    self._batch_unpacker,
+                    wire.pack_shard_batch(
+                        self._batch_packer,
+                        req_id=req["req_id"],
+                        shard=req["shard"],
+                        staged=StagedSequences(seq=s.seq, priorities=None),
+                        slots=s.slots,
+                        gens=s.gens,
+                        probs=s.probs,
+                        priority_sum=shard.scaled_sum(),
+                        occupancy=shard.occupancy(),
+                    ),
+                )
+            )
+            seqs.append(resp["staged"].seq)
+            probs.append(
+                combine_probs(resp["probs"], float(sums[shard_id]), total)
+            )
+            handles.append((req["shard"], resp["slots"], resp["gens"]))
+        seq = jax.tree_util.tree_map(
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
+            *seqs,
+        )
+        prob = np.concatenate(probs)
+        shard_of = np.concatenate(
+            [np.full(len(h[1]), h[0], np.int64) for h in handles]
+        )
+        slots = np.concatenate([h[1] for h in handles])
+        gens = np.concatenate([h[2] for h in handles])
+        perm = rng.permutation(n_draws)
+        seq = jax.tree_util.tree_map(lambda x: x[perm], seq)
+        return (
+            seq,
+            prob[perm],
+            (shard_of[perm], slots[perm], gens[perm]),
+            self.shards.occupancy_total(),
+        )
+
+    def _write_back(self, handles, prios: np.ndarray) -> None:
+        """TD write-back through PRIO frames, grouped per shard; stale
+        generations (ring-evicted slots) are ignored shard-side."""
+        shard_of, slots, gens = handles
+        prios = np.asarray(prios, np.float32).reshape(-1)
+        for shard_id in np.unique(shard_of):
+            m = shard_of == shard_id
+            upd = wire.unpack_prio_update(
+                self._roundtrip(
+                    self._req_unpacker,
+                    wire.pack_prio_update(
+                        self._req_packer,
+                        shard=int(shard_id),
+                        slots=slots[m],
+                        gens=gens[m],
+                        priorities=prios[m],
+                    ),
+                )
+            )
+            if upd["shard"] >= self.num_shards:
+                # The codec checks >= 0; the upper bound is deployment
+                # state only this side knows.  Unreachable via the
+                # loopback (we packed it), load-bearing the day a remote
+                # shard speaks these frames.
+                raise wire.WireFormatError(
+                    f"PRIO shard {upd['shard']} outside fleet of "
+                    f"{self.num_shards}"
+                )
+            self.shards.shards[upd["shard"]].update_priorities(
+                upd["slots"], upd["gens"], upd["priorities"]
+            )
+
+    # ------------------------------------------------------------------- run
+    def run(
+        self,
+        num_train_phases: int,
+        state: Optional[TrainerState] = None,
+        log_every: int = 50,
+        log_fn=print,
+        metrics_fn: Optional[Callable[[int, Dict[str, float]], None]] = None,
+        minutes: Optional[float] = None,
+        ckpt=None,
+        checkpoint_every: int = 0,
+        resume_from: Optional[Dict[str, float]] = None,
+        phase_fn: Optional[Callable[[int], None]] = None,
+        trace_sample: float = 0.0,
+    ) -> TrainerState:
+        """Wait for ``min_replay`` resident sequences across the shards,
+        then run ``num_train_phases`` pull-learn phases (K·B two-level
+        draws + K compiled updates + PRIO write-back each).  Same
+        checkpoint/resume/counter contract as ``FleetLearner.run`` (the
+        shards, like the central arena, are never checkpointed: a
+        resumed learner re-fills them from live actors)."""
+        if self.server.address is None:
+            raise RuntimeError("call start() before run()")
+        t = self.trainer
+        cfg = t.config
+        state = t.init() if state is None else state
+        cstate, lstate = split_state(state)
+        train = lstate.train
+        rng = lstate.rng
+        np_rng = np.random.default_rng(cfg.seed)
+        deadline = (
+            time.monotonic() + minutes * 60 if minutes is not None else None
+        )
+        self.sampler_wait.reset()
+        self.sample_assemble.reset()
+        resume_from = resume_from or {}
+        version = int(resume_from.get("param_version", 0)) + 1
+        self.server.publish_params(version, self._snapshot_params(train))
+
+        n_draws = cfg.learner_steps * cfg.batch_size
+        drained = int(resume_from.get("drained", 0))
+        drained_at_start = drained
+        last_metrics: Dict[str, Any] = {}
+        ep_ret_sum = float(resume_from.get("ep_return_sum", 0.0))
+        ep_count = float(resume_from.get("ep_count", 0.0))
+        env_steps_total = float(resume_from.get("env_steps_total", 0.0))
+        episodes_total = float(resume_from.get("episodes_total", 0.0))
+        t0 = time.monotonic()
+        train_t0: Optional[float] = None
+        marked_steady = False
+
+        def emit_log(phase: int, scalars: Dict[str, float]) -> None:
+            if metrics_fn is not None:
+                metrics_fn(phase, scalars)
+                return
+            log_fn(
+                f"sampler phase {phase}/{num_train_phases} "
+                + " ".join(f"{k} {v:.3g}" for k, v in scalars.items())
+            )
+
+        def fold_stats() -> None:
+            nonlocal env_steps_total, ep_ret_sum, ep_count, episodes_total
+            s = self.shards.pop_stats()
+            env_steps_total += s["env_steps_delta"]
+            ep_ret_sum += s["ep_return_sum"]
+            ep_count += s["ep_count"]
+            episodes_total += s["ep_count"]
+
+        try:
+            # ------------------------------------------------ absorb phase
+            # The recovery contract's re-entry point too: a resumed
+            # learner waits here while reconnecting actors refill shards.
+            last_growth = time.monotonic()
+            last_occ = -1
+            t_wait = time.monotonic()
+            while self.shards.occupancy_total() < cfg.min_replay:
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                occ = self.shards.occupancy_total()
+                if occ != last_occ:
+                    last_occ = occ
+                    last_growth = time.monotonic()
+                # Cold start pays actor spawn + jax import + collect
+                # compile — double the steady bound, like the drain loop.
+                bound = self.config.idle_timeout_s * (2.0 if occ == 0 else 1.0)
+                if time.monotonic() - last_growth > bound:
+                    raise RuntimeError(
+                        f"sampler starved: shard occupancy stuck at {occ} "
+                        f"for {bound:.0f}s — are the actors alive? "
+                        f"(check flight.jsonl)"
+                    )
+                time.sleep(0.05)
+            self.sampler_wait.add(time.monotonic() - t_wait)
+
+            while drained < num_train_phases:
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                fold_stats()
+                tr = obs_trace.maybe_start(trace_sample)
+                t_req = time.time()
+                t_assemble = time.monotonic()
+                seq_np, probs_np, handles, occ = self._pull_phase_batches(
+                    n_draws, np_rng
+                )
+                t_batches = time.time()
+                self.sample_assemble.add(time.monotonic() - t_assemble)
+                # [n] -> [K, B] for the compiled K-update scan.
+                seqs = jax.tree_util.tree_map(
+                    lambda x: np.reshape(
+                        x, (cfg.learner_steps, cfg.batch_size) + x.shape[1:]
+                    ),
+                    seq_np,
+                )
+                probs = np.reshape(
+                    probs_np.astype(np.float32),
+                    (cfg.learner_steps, cfg.batch_size),
+                )
+                rng, key = jax.random.split(rng)
+                train, prios_dev, last_metrics = self._learn_prog(
+                    train, seqs, probs, np.float32(occ), key
+                )
+                t_dispatch = time.time()
+                # ONE host fetch per phase: the write-back priorities
+                # must come back to the host-side shards (there is no
+                # in-graph arena scatter on this path).  The blocking
+                # fetch also makes the learn hop honest for free.
+                prios = jax.device_get(prios_dev)
+                t_learn_done = time.time()
+                self._write_back(handles, prios)
+                self.trained_seqs_total += n_draws
+                self._obs_trained.inc(n_draws)
+                if tr is not None:
+                    # The sampler-path trace chain (obs/trace.py): the
+                    # two new hops + learn, recorded together
+                    # (all-or-nothing, like the 8-hop wire chain).
+                    obs_trace.record_hop(
+                        "sample_req", t_req, t_batches, tr.trace_id,
+                        draws=n_draws,
+                    )
+                    obs_trace.record_hop(
+                        "batch_return", t_batches, t_dispatch,
+                        tr.trace_id, seqs=n_draws,
+                    )
+                    obs_trace.record_hop(
+                        "learn", t_dispatch, t_learn_done, tr.trace_id
+                    )
+                drained += 1
+                if train_t0 is None:
+                    jax.block_until_ready(train.step)
+                    train_t0 = time.monotonic()
+                if not marked_steady:
+                    self.server.mark_steady()
+                    marked_steady = True
+                if phase_fn is not None:
+                    phase_fn(drained)
+                if (
+                    ckpt is not None
+                    and checkpoint_every > 0
+                    and drained % checkpoint_every == 0
+                ):
+                    self._save_checkpoint(
+                        ckpt, drained, state, cstate, train, rng, lstate,
+                        {
+                            "drained": drained,
+                            "env_steps_total": env_steps_total,
+                            "ep_return_sum": ep_ret_sum,
+                            "ep_count": ep_count,
+                            "episodes_total": episodes_total,
+                            "param_version": version,
+                        },
+                    )
+                if drained % max(self.config.publish_every, 1) == 0:
+                    version += 1
+                    self.server.publish_params(
+                        version, self._snapshot_params(train)
+                    )
+                    if log_every and drained % log_every == 0:
+                        flight_event("param_publish", version=version)
+                if log_every and drained % log_every == 0:
+                    lstep, m = jax.device_get((train.step, last_metrics))
+                    scalars = {
+                        "episode_return_mean": ep_ret_sum / max(ep_count, 1.0),
+                        "episodes": ep_count,
+                        "env_steps": env_steps_total,
+                        "learner_steps": float(lstep),
+                        "replay_occupancy": float(occ),
+                        **{k: float(v) for k, v in m.items()},
+                    }
+                    ep_ret_sum = 0.0
+                    ep_count = 0.0
+                    t._obs_publish(scalars)
+                    emit_log(drained, scalars)
+        finally:
+            jax.block_until_ready(train.step)
+            t_end = time.monotonic()
+            fold_stats()
+            wall = max(t_end - t0, 1e-9)
+            _, sw_total, sw_p50, sw_p99 = self.sampler_wait.snapshot()
+            srv = self.server
+            drained_here = drained - drained_at_start
+            trained = drained_here * n_draws
+            self._counters = {
+                "drained": float(drained),
+                "env_steps_total": env_steps_total,
+                "ep_return_sum": ep_ret_sum,
+                "ep_count": ep_count,
+                "episodes_total": episodes_total,
+                "param_version": float(version),
+            }
+            self._stats = {
+                "train_phases": float(drained_here),
+                "train_phases_total": float(drained),
+                "trained_seqs": float(trained),
+                "wall_s": wall,
+                "learner_steps_per_sec": (
+                    drained_here * cfg.learner_steps / wall
+                ),
+                # The headline boundary: only SAMPLED sequences cross
+                # into training (bench.py fleet_sampler compares this
+                # against the central drain's bytes_per_trained_seq).
+                "sample_bytes_total": float(self.sample_bytes_total),
+                "bytes_per_trained_seq": (
+                    self.sample_bytes_total / max(trained, 1)
+                ),
+                # The actor wire, for honesty: collection traffic still
+                # lands on the (in-learner) shards today.
+                "seqs_bytes_total": float(srv.seqs_bytes_total),
+                "collected_seqs": float(srv.seqs_received_total),
+                "sheds": float(srv.shed_total),  # structurally 0
+                "replay_occupancy": float(self.shards.occupancy_total()),
+                "sampler_wait_p50_ms": sw_p50 * 1e3,
+                "sampler_wait_p99_ms": sw_p99 * 1e3,
+                "sampler_wait_total_s": sw_total,
+            }
+            if train_t0 is not None:
+                train_wall = max(t_end - train_t0, 1e-9)
+                self._stats["train_wall_s"] = train_wall
+                self._stats["train_learner_steps_per_sec"] = (
+                    max(drained_here - 1, 0) * cfg.learner_steps / train_wall
+                )
+        lstate = dataclasses.replace(lstate, train=train, rng=rng)
+        return dataclasses.replace(
+            merge_state(state, cstate, lstate),
+            phase_idx=cstate.phase_idx + drained,
+        )
+
+    def _save_checkpoint(
+        self, ckpt, step: int, state, cstate, train, rng, lstate, counters
+    ) -> None:
+        # The ADVANCED per-phase rng, not lstate's run-start key: a light
+        # checkpoint persists only the train subtree today, but the saved
+        # state must never claim a key stream the run already consumed.
+        lstate = dataclasses.replace(lstate, train=train, rng=rng)
+        ckpt.save(step, merge_state(state, cstate, lstate))
+        save_fleet_counters(ckpt.directory, step, counters)
+        prune_fleet_counters(ckpt.directory, ckpt.all_steps())
+
+    def _snapshot_params(self, train) -> Any:
+        """The shared published-snapshot contract (ingest.snapshot_params):
+        all four net cores + step, one definition for both learners."""
+        return snapshot_params(train)

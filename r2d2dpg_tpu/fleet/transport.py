@@ -72,6 +72,14 @@ K_BYE = 5  # either side: orderly goodbye
 K_TELEM = 6  # actor -> ingest: registry-scalar snapshot (~1 Hz, no ack)
 K_PING = 7  # either side: liveness probe after a silent read deadline
 K_PONG = 8  # either side: liveness answer (any frame also proves liveness)
+# In-network experience sampling (fleet/sampler.py, ISSUE 10): the learner
+# PULLS training batches from replay shards instead of draining every
+# collected sequence.  Payloads ride the fleet/wire.py zero-copy codec
+# (pack_sample_req / pack_shard_batch / pack_prio_update — golden
+# byte-layout tests in tests/test_wire.py).
+K_SAMPLE_REQ = 9  # learner -> shard: {"req_id", "shard", "quota"}
+K_BATCH = 10  # shard -> learner: sampled sequences + slots/gens/probs + sums
+K_PRIO = 11  # learner -> shard: TD priority write-back keyed slot/generation
 
 # 256 MiB default ceiling: a humanoid-shaped staged batch (256 envs x seq
 # 85) is ~20 MiB, so this bounds corruption blast radius without touching

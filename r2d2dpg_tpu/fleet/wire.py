@@ -496,6 +496,151 @@ def _rebuild(node: Any, body, cursor: List[int]) -> Any:
     raise WireFormatError(f"malformed schema node {node!r}")
 
 
+# ----------------------------------------------- sampler frames (ISSUE 10)
+# The in-network-sampling control/tensor payloads (transport.K_SAMPLE_REQ /
+# K_BATCH / K_PRIO, fleet/sampler.py).  Each is an ordinary tree through
+# the zero-copy codec above — these helpers exist so both ends build the
+# SAME key order (the schema JSON, and therefore its crc32 id and the
+# golden byte layout in tests/test_wire.py, is keyed on it) and so the
+# unpack side validates shape before anything touches the fields.  No new
+# byte format: the zip-bomb guard, schema cache, and malformed-frame
+# refusals of ``TreeUnpacker`` apply to these frames verbatim.
+
+
+def pack_sample_req(
+    packer: "TreePacker", *, req_id: int, shard: int, quota: int
+) -> List[Any]:
+    """SAMPLE_REQ payload: the learner asks shard ``shard`` for ``quota``
+    of this phase's draws (two-level level 1 — quotas are drawn from a
+    multinomial over the shards' advertised priority sums)."""
+    return packer.pack(
+        {"req_id": int(req_id), "shard": int(shard), "quota": int(quota)}
+    )
+
+
+def unpack_sample_req(obj: Any) -> Dict[str, int]:
+    if not (
+        isinstance(obj, dict)
+        and all(isinstance(obj.get(k), int) for k in ("req_id", "shard", "quota"))
+    ):
+        raise WireFormatError(f"malformed SAMPLE_REQ payload {type(obj).__name__}")
+    if obj["quota"] < 0 or obj["shard"] < 0:
+        raise WireFormatError("SAMPLE_REQ quota/shard must be >= 0")
+    return obj
+
+
+def pack_shard_batch(
+    packer: "TreePacker",
+    *,
+    req_id: int,
+    shard: int,
+    staged: Any,  # replay.StagedSequences (priorities None: learner ranks IS-side)
+    slots: np.ndarray,
+    gens: np.ndarray,
+    probs: np.ndarray,
+    priority_sum: float,
+    occupancy: int,
+) -> List[Any]:
+    """BATCH payload: a shard's training-ready answer.  ``slots``/``gens``
+    are the write-back handles (PRIO frames echo them; a generation the
+    ring has moved past is ignored shard-side), ``probs`` the
+    within-shard probabilities, and ``priority_sum``/``occupancy`` the
+    shard's post-sample advertisement.  The in-learner loopback reads
+    the shard sums directly (fresher than any frame), so the
+    advertisement exists FOR the cross-process deployment: a remote
+    learner refreshes its quota weights from these fields instead of a
+    separate poll frame, which is why ``unpack_shard_batch`` validates
+    them even though today's loopback never consumes them."""
+    return packer.pack(
+        {
+            "req_id": int(req_id),
+            "shard": int(shard),
+            "priority_sum": float(priority_sum),
+            "occupancy": int(occupancy),
+            "slots": np.ascontiguousarray(slots, np.int64),
+            "gens": np.ascontiguousarray(gens, np.int64),
+            "probs": np.ascontiguousarray(probs, np.float64),
+            "staged": staged,
+        }
+    )
+
+
+def unpack_shard_batch(obj: Any) -> Dict[str, Any]:
+    if not (
+        isinstance(obj, dict)
+        and isinstance(obj.get("req_id"), int)
+        and isinstance(obj.get("shard"), int)
+        and isinstance(obj.get("staged"), StagedSequences)
+        # The advertisement fields must be well-formed even though the
+        # in-process loopback reads shard sums directly: a cross-process
+        # learner refreshes its quota weights from them (pack_shard_batch
+        # docstring), and a remote frame omitting them must refuse here,
+        # not KeyError in that learner's quota math.
+        and isinstance(obj.get("priority_sum"), float)
+        and isinstance(obj.get("occupancy"), int)
+        and obj["priority_sum"] >= 0.0
+        and obj["occupancy"] >= 0
+        and all(
+            isinstance(obj.get(k), np.ndarray)
+            for k in ("slots", "gens", "probs")
+        )
+    ):
+        raise WireFormatError("malformed BATCH payload")
+    n = obj["slots"].shape[0]
+    if not (
+        obj["gens"].shape == (n,)
+        and obj["probs"].shape == (n,)
+        and np.shape(obj["staged"].seq.reward)[0] == n
+    ):
+        raise WireFormatError("BATCH handles/probs/sequences length mismatch")
+    # Range discipline (the validate-before-touch contract): a negative
+    # shard index or slot from a confused/hostile peer must refuse HERE,
+    # not alias to python negative indexing in the shard's ring arrays.
+    if obj["shard"] < 0 or (n and int(obj["slots"].min()) < 0):
+        raise WireFormatError("BATCH shard/slots must be >= 0")
+    return obj
+
+
+def pack_prio_update(
+    packer: "TreePacker",
+    *,
+    shard: int,
+    slots: np.ndarray,
+    gens: np.ndarray,
+    priorities: np.ndarray,
+) -> List[Any]:
+    """PRIO payload: learner TD-error write-back, keyed (shard, slot,
+    generation) — the reverse ride of the versioned param-publish path.
+    ``priorities`` stays float32 on every lane (``F32_PINNED_LEAVES``:
+    it feeds the sampling CDF)."""
+    return packer.pack(
+        {
+            "shard": int(shard),
+            "slots": np.ascontiguousarray(slots, np.int64),
+            "gens": np.ascontiguousarray(gens, np.int64),
+            "priorities": np.ascontiguousarray(priorities, np.float32),
+        }
+    )
+
+
+def unpack_prio_update(obj: Any) -> Dict[str, Any]:
+    if not (
+        isinstance(obj, dict)
+        and isinstance(obj.get("shard"), int)
+        and all(
+            isinstance(obj.get(k), np.ndarray)
+            for k in ("slots", "gens", "priorities")
+        )
+    ):
+        raise WireFormatError("malformed PRIO payload")
+    n = obj["slots"].shape[0]
+    if not (obj["gens"].shape == (n,) and obj["priorities"].shape == (n,)):
+        raise WireFormatError("PRIO handles/priorities length mismatch")
+    if obj["shard"] < 0 or (n and int(obj["slots"].min()) < 0):
+        raise WireFormatError("PRIO shard/slots must be >= 0")
+    return obj
+
+
 class TreeUnpacker:
     """Per-connection receiver state: schema cache keyed by schema id.
 

@@ -33,7 +33,13 @@ The hops are CONTIGUOUS intervals, so their sum is the end-to-end
 collect->learn latency of that batch — the learner-wait budget becomes
 attributable per hop (Podracer's per-stage accounting, PAPERS.md
 2104.06272).  The in-process pipelined executor records the subset that
-exists without a wire: collect, enqueue, arena_add, learn.
+exists without a wire: collect, enqueue, arena_add, learn.  The
+in-network sampler (``--replay-shards N``, fleet/sampler.py) replaces
+the drain-side hops with its own contiguous chain per sampled train
+phase: ``sample_req -> batch_return -> learn`` (quota + frame exchange,
+batch stacking + dispatch, device execution) — recorded all-or-nothing,
+with sharded ingest dropping SEQS sidecars so no partial wire chain ever
+mixes in.
 
 **Sampling**: ``maybe_start(rate)`` decides per staged batch at collection
 time.  The default rate is 0 — no trace id is allocated, no span recorded,
@@ -58,7 +64,9 @@ from typing import Optional
 from r2d2dpg_tpu.obs.flight import get_flight_recorder
 from r2d2dpg_tpu.obs.registry import get_registry
 
-HOPS = (
+# The central-drain wire path's 8 contiguous hops (the chain the 2-actor
+# fleet e2e pins end to end — tests/test_obs_fleet.py).
+WIRE_HOPS = (
     "collect",
     "encode",
     "transit",
@@ -68,6 +76,20 @@ HOPS = (
     "arena_add",
     "learn",
 )
+# In-network sampling hops (fleet/sampler.py, ISSUE 10): the sampler
+# learner's pull path replaces enqueue/coalesce/arena_add —
+# ``sample_req`` spans quota computation + SAMPLE_REQ issue through the
+# shard draws + BATCH decode, ``batch_return`` spans batch
+# stacking/reshape + the learn dispatch, then ``learn`` as before.  The
+# all-or-nothing contract extends per chain: a sampled sampler phase
+# records its 3-hop chain (sample_req -> batch_return -> learn) together
+# or not at all — never a partial chain, and never mixed with the 8-hop
+# wire chain (sharded ingest drops SEQS sidecars).
+SAMPLER_HOPS = (
+    "sample_req",
+    "batch_return",
+)
+HOPS = WIRE_HOPS + SAMPLER_HOPS
 
 
 @dataclasses.dataclass

@@ -1,4 +1,6 @@
-"""HBM sequence replay (SURVEY.md §2.2): ring arena, prioritized sampling."""
+"""Sequence replay (SURVEY.md §2.2): the HBM ring arena with prioritized
+sampling, and the host-side ingest-edge shards of in-network sampling
+(``replay/sharded.py``, docs/REPLAY.md)."""
 
 from r2d2dpg_tpu.replay.arena import (
     ArenaState,
@@ -7,11 +9,21 @@ from r2d2dpg_tpu.replay.arena import (
     SequenceBatch,
     StagedSequences,
 )
+from r2d2dpg_tpu.replay.sharded import (
+    ReplayShard,
+    ShardSample,
+    combine_probs,
+    shard_quotas,
+)
 
 __all__ = [
     "ArenaState",
     "ReplayArena",
+    "ReplayShard",
     "SampleResult",
     "SequenceBatch",
+    "ShardSample",
     "StagedSequences",
+    "combine_probs",
+    "shard_quotas",
 ]

@@ -1,0 +1,281 @@
+"""Sharded prioritized replay at the ingest edge (ISSUE 10).
+
+The central ``ReplayArena`` is one device-resident ring behind one drain
+thread: every sequence the fleet collects crosses the wire into it and is
+scattered by a single consumer, whether or not it is ever sampled.
+In-Network Experience Sampling (PAPERS.md 2110.13506) inverts this: replay
+lives in N **shards at the ingest edge**, each owning a slice of capacity
+with its own priority structure, fed concurrently by the actor traffic
+routed to it — and the learner *pulls* training-ready batches, so only
+sampled sequences cross into the training path.
+
+This module is the shard itself plus the two-level sampling math; the
+fleet-side plumbing (actor→shard routing, SAMPLE_REQ/BATCH/PRIO frames,
+the learner pull loop) lives in ``fleet/sampler.py``.
+
+**Two-level sampling** (docs/REPLAY.md has the derivation): the central
+proportional distribution draws slot ``i`` with probability
+``p_i^alpha / sum_j p_j^alpha`` over ALL slots.  Factor the global sum by
+shard::
+
+    P(slot i in shard s) = (S_s / S_total) * (p_i^alpha / S_s)
+                         =  p_i^alpha / S_total          where S_s = sum over shard s
+
+so drawing shard assignments from a multinomial over the per-shard sums
+``S_s`` (``shard_quotas``) and then within-shard proportionally
+reproduces the central distribution EXACTLY — sharding is layout, never
+semantics (tests/test_replay.py pins this on exact-integer priorities).
+The combined per-draw probability for importance weights is
+``(S_s / S_total) * within_prob``, i.e. exactly what the central
+``ReplayArena.sample`` reports.
+
+**Write-back versioning**: every slot carries a monotone *generation*
+(bumped each time the ring overwrites it).  A sample hands out
+``(slot, generation)`` pairs; a later priority write-back is applied only
+where the generation still matches — a slot the ring has since evicted
+ignores the stale update, the same posture as the actors' param-version
+regression guard (docs/FLEET.md).
+
+The shard is **host-side numpy** on purpose: it lives where experience
+arrives (the ingest edge), is written by that connection's handler
+thread and read by the sampler — a per-shard lock suffices, and N shards
+make adds concurrent across handlers, which is exactly the serialization
+point the central drain was.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from r2d2dpg_tpu.ops.priority import PRIORITY_EPS
+from r2d2dpg_tpu.replay.arena import SequenceBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardSample:
+    """One shard's answer to a sample request (within-shard quantities).
+
+    ``probs`` are WITHIN-shard probabilities (``p^alpha / S_s``); the
+    learner combines them with the shard-level factor ``S_s / S_total``
+    (``combine_probs``) to recover the central distribution's
+    per-draw probability for importance weighting.  ``gens`` are the
+    sampled slots' generations at sample time — the write-back version
+    key (stale generations are ignored by ``update_priorities``)."""
+
+    seq: SequenceBatch  # numpy leaves [n, L, ...]
+    slots: np.ndarray  # [n] int64 shard-local slot indices
+    gens: np.ndarray  # [n] int64 slot generations at sample time
+    probs: np.ndarray  # [n] float64 within-shard probabilities
+
+
+class ReplayShard:
+    """One slice of replay capacity: a host-side prioritized ring.
+
+    Thread contract: the feeding handler thread calls ``add``; the
+    sampler thread calls ``sample``/``update_priorities``/the stat
+    reads.  Every public method takes the shard lock, so concurrency is
+    per-shard — N shards, N concurrent writers fleet-wide.
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        *,
+        alpha: float = 0.6,
+        prioritized: bool = True,
+        shard_id: int = 0,
+    ):
+        if capacity < 1:
+            raise ValueError("shard capacity must be >= 1")
+        self.capacity = capacity
+        self.alpha = alpha
+        self.prioritized = prioritized
+        self.shard_id = shard_id
+        self._lock = threading.Lock()
+        self._data = None  # struct-of-arrays, allocated from the first add
+        self._priority = np.zeros((capacity,), np.float64)  # raw; 0 = empty
+        self._scaled = np.zeros((capacity,), np.float64)  # p^alpha (or 1.0)
+        self._generation = np.zeros((capacity,), np.int64)
+        self._cursor = 0
+        self.total_added = 0
+
+    # ------------------------------------------------------------------ add
+    def _alloc(self, seq: SequenceBatch) -> None:
+        import jax
+
+        def zeros(x):
+            x = np.asarray(x)
+            return np.zeros((self.capacity,) + x.shape[1:], x.dtype)
+
+        self._data = jax.tree_util.tree_map(zeros, seq)
+
+    def add(
+        self, seq: SequenceBatch, priorities: Optional[np.ndarray]
+    ) -> int:
+        """Ring-write B sequences at the cursor (FIFO overwrite).
+
+        ``priorities=None`` (a config whose actors do not rank locally)
+        enters at the shard's max priority so far, floor 1.0 — the
+        central ``initial_priority="max"`` semantics.  Overwritten slots
+        bump their generation, which is what makes a stale write-back
+        detectable.  Returns B."""
+        import jax
+
+        b = int(np.shape(seq.reward)[0])
+        with self._lock:
+            if self._data is None:
+                self._alloc(seq)
+            if priorities is None:
+                entry = max(float(self._priority.max()), 1.0)
+                prios = np.full((b,), entry, np.float64)
+            else:
+                prios = np.asarray(priorities, np.float64)
+            prios = np.maximum(prios, PRIORITY_EPS)
+            idx = (self._cursor + np.arange(b)) % self.capacity
+            jax.tree_util.tree_map(
+                lambda buf, new: buf.__setitem__(idx, np.asarray(new)),
+                self._data,
+                seq,
+            )
+            self._priority[idx] = prios
+            self._scaled[idx] = prios**self.alpha if self.prioritized else 1.0
+            self._generation[idx] += 1
+            self._cursor = int((self._cursor + b) % self.capacity)
+            self.total_added += b
+        return b
+
+    # --------------------------------------------------------------- sample
+    def sample(self, n: int, rng: np.random.Generator) -> ShardSample:
+        """Draw ``n`` sequences proportional to ``p^alpha`` within this
+        shard (uniform over filled slots when unprioritized).  Caller
+        guarantees the shard is non-empty (quota draws weight empty
+        shards at 0 — ``shard_quotas``)."""
+        with self._lock:
+            if self._data is None or not (self._priority > 0).any():
+                raise ValueError(
+                    f"shard {self.shard_id} is empty; quotas must not "
+                    f"route draws here"
+                )
+            scaled = self._scaled
+            cdf = np.cumsum(scaled)
+            # ``total`` must be cdf[-1] itself, NOT scaled.sum(): numpy's
+            # pairwise summation can make the latter exceed the
+            # sequential cumsum's last element, and a draw landing in
+            # that float gap would searchsort past the end.  The clamp
+            # goes to the last FILLED slot (side="right" never selects an
+            # interior zero slot; empties are a suffix until the ring
+            # wraps) — clamping to capacity-1 could hand out an EMPTY
+            # slot whose generation-0 handle a later write-back would
+            # wrongly match.
+            total = float(cdf[-1])
+            u = rng.random(n) * total
+            last_filled = int(np.flatnonzero(scaled)[-1])
+            slots = np.minimum(
+                np.searchsorted(cdf, u, side="right"), last_filled
+            )
+            probs = scaled[slots] / max(total, 1e-300)
+            import jax
+
+            seq = jax.tree_util.tree_map(lambda buf: buf[slots], self._data)
+            gens = self._generation[slots].copy()
+        return ShardSample(
+            seq=seq,
+            slots=slots.astype(np.int64),
+            gens=gens,
+            probs=probs.astype(np.float64),
+        )
+
+    # ------------------------------------------------------- priority update
+    def update_priorities(
+        self,
+        slots: np.ndarray,
+        gens: np.ndarray,
+        priorities: np.ndarray,
+    ) -> int:
+        """Learner TD-error write-back, version-checked.
+
+        Applied only where the slot's generation still equals ``gens``
+        (the sample-time version): a slot the ring has overwritten since
+        holds a NEWER sequence whose priority must not be clobbered by a
+        verdict about the old one — stale versions are ignored, like
+        param regressions on the actor side.  Duplicate slots in one
+        batch resolve last-write-wins, matching the central scatter.
+        Returns how many entries applied."""
+        slots = np.asarray(slots, np.int64)
+        gens = np.asarray(gens, np.int64)
+        prios = np.maximum(np.asarray(priorities, np.float64), PRIORITY_EPS)
+        if slots.size and not (
+            0 <= int(slots.min()) and int(slots.max()) < self.capacity
+        ):
+            # Out-of-range handles would alias (negative python indexing)
+            # or IndexError mid-update — refuse the whole frame loudly,
+            # the wire validators' contract carried to the ring boundary.
+            raise ValueError(
+                f"write-back slots outside shard capacity {self.capacity}"
+            )
+        with self._lock:
+            fresh = self._generation[slots] == gens
+            idx = slots[fresh]
+            self._priority[idx] = prios[fresh]
+            self._scaled[idx] = (
+                prios[fresh] ** self.alpha if self.prioritized else 1.0
+            )
+            return int(fresh.sum())
+
+    # ------------------------------------------------------------------ stats
+    def occupancy(self) -> int:
+        with self._lock:
+            return int((self._priority > 0).sum())
+
+    def priority_sum(self) -> float:
+        """Raw priority sum (the obs gauge's value — mirrors the central
+        ``r2d2dpg_replay_priority_sum``)."""
+        with self._lock:
+            return float(self._priority.sum())
+
+    def scaled_sum(self) -> float:
+        """The quota weight this shard advertises: ``sum p^alpha`` over
+        filled slots (occupancy when unprioritized)."""
+        with self._lock:
+            return float(self._scaled.sum())
+
+
+def shard_quotas(
+    scaled_sums: Sequence[float], n: int, rng: np.random.Generator
+) -> np.ndarray:
+    """Level 1 of two-level sampling: how many of ``n`` draws each shard
+    serves, multinomial over the advertised ``sum p^alpha`` weights.
+
+    Empty shards (weight 0) get quota 0; an all-empty fleet is a caller
+    error (the absorb gate holds until ``min_replay``)."""
+    w = np.asarray(scaled_sums, np.float64)
+    if (w < 0).any():
+        raise ValueError("negative shard priority sum")
+    total = w.sum()
+    if total <= 0:
+        raise ValueError("all shards empty: nothing to sample")
+    return rng.multinomial(n, w / total)
+
+
+def combine_probs(
+    within_probs: np.ndarray, shard_sum: float, total_sum: float
+) -> np.ndarray:
+    """Level-2 probabilities -> the per-draw probability of the REALIZED
+    two-stage procedure: ``(S_s / S_total) * within_prob`` (the
+    module-doc factorization) — what importance weights must see.
+
+    Deliberate under concurrency: ``shard_sum``/``total_sum`` are the
+    QUOTA-time snapshot (the multinomial really was drawn from them)
+    while ``within_probs`` are normalized against the shard's
+    SAMPLE-time state (the within-draw really used it), so the product
+    is exactly the marginal probability with which each slot was drawn
+    even when handlers added between the two moments.  "Correcting"
+    either factor to the other timepoint would make the weights describe
+    a draw that never happened."""
+    return np.asarray(within_probs, np.float64) * (
+        shard_sum / max(total_sum, 1e-300)
+    )

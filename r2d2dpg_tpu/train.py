@@ -114,6 +114,21 @@ def parse_args(argv=None) -> argparse.Namespace:
         "are bucketed to powers of two <= K to bound drain-program "
         "compiles)"
     )
+    # In-network experience sampling (docs/REPLAY.md): replay sharded at
+    # the ingest edge, learner pulls training-ready batches.
+    p.add_argument(
+        "--replay-shards", type=int, default=0, metavar="N",
+        help="shard prioritized replay across N ingest-edge shards "
+        "(fleet/sampler.py): each actor's SEQS traffic feeds its "
+        "consistent-hash shard directly (no central drain thread), and "
+        "the learner PULLS batches via SAMPLE_REQ/BATCH frames with "
+        "quotas proportional to each shard's priority sum — two-level "
+        "sampling that preserves the central proportional distribution; "
+        "TD priorities ride back as versioned PRIO frames.  Requires "
+        "--actors N (with --actors 0 only --replay-shards 1 is accepted "
+        "and routes the untouched phase-locked loop — the determinism "
+        "anchor).  0 = off (central drain)"
+    )
     # Fleet fault tolerance (docs/FLEET.md "Failure modes & recovery").
     p.add_argument(
         "--fleet-heartbeat", type=float, default=None, metavar="S",
@@ -361,6 +376,45 @@ def run(args) -> dict:
             "--chaos-spec require "
             "--actors N (the in-process schedules have no fleet wire)"
         )
+    if args.replay_shards:
+        if args.replay_shards < 1:
+            raise SystemExit("--replay-shards must be >= 1 (0 = off)")
+        if not args.actors and args.replay_shards > 1:
+            # Replay shards are fed by actor SEQS traffic; without a
+            # fleet there is nothing to shard.  --replay-shards 1 alone
+            # is accepted and routes the untouched phase-locked loop —
+            # the determinism anchor sampler_gate enforces
+            # (docs/REPLAY.md "Determinism anchor").
+            raise SystemExit(
+                "--replay-shards N >= 2 requires --actors N (replay "
+                "shards are fed by actor traffic; docs/REPLAY.md)"
+            )
+        if args.drain_coalesce != 1:
+            raise SystemExit(
+                "--replay-shards does not compose with --drain-coalesce "
+                "(there is no central drain to coalesce; docs/REPLAY.md "
+                "'Refused knobs')"
+            )
+        if args.learner_dp:
+            raise SystemExit(
+                "--replay-shards does not compose with --learner-dp (the "
+                "dp learner shards the DEVICE arena the sampler path "
+                "bypasses; docs/REPLAY.md 'Refused knobs')"
+            )
+        if args.actors and args.replay_shards > args.actors:
+            # Integer actor ids route round-robin, so only
+            # min(actors, shards) shards ever get a feed: the surplus
+            # shards stay empty forever and effective replay capacity
+            # silently shrinks to that fraction — never silently.
+            print(
+                f"replay-shards: WARNING — {args.replay_shards} shards "
+                f"but only {args.actors} actors: "
+                f"{args.replay_shards - args.actors} shards will never "
+                f"receive traffic and effective replay capacity is "
+                f"{args.actors}/{args.replay_shards} of the configured "
+                f"capacity (docs/REPLAY.md 'Topology')",
+                flush=True,
+            )
     if args.learner_dp:
         if args.learner_dp < 1:
             raise SystemExit("--learner-dp must be >= 1 (0 = off)")
@@ -382,12 +436,24 @@ def run(args) -> dict:
     if args.chaos_spec:
         # Validate the grammar up front: a malformed drill schedule must
         # refuse at startup, not after the fleet has spawned.
-        from r2d2dpg_tpu.fleet.chaos import parse_chaos_spec
+        from r2d2dpg_tpu.fleet.chaos import SAMPLER_FAULTS, parse_chaos_spec
 
         try:
-            parse_chaos_spec(args.chaos_spec)
+            faults = parse_chaos_spec(args.chaos_spec)
         except ValueError as e:
             raise SystemExit(f"--chaos-spec: {e}")
+        bad = sorted({f.kind for f in faults if f.kind in SAMPLER_FAULTS})
+        if bad and not args.replay_shards:
+            # A sampler-class drill on the central drain would stall the
+            # DRAIN thread (queue fills, actors shed) while recording
+            # evidence for an invariant — "shards ring-evict, nothing
+            # sheds" — that path cannot exhibit: refuse the mislabeled
+            # drill like every other incoherent knob combo.
+            raise SystemExit(
+                f"--chaos-spec faults {bad} drill the in-network sampler "
+                f"peer class and require --replay-shards N "
+                f"(docs/REPLAY.md 'Recovery contract')"
+            )
     if args.fleet_heartbeat is not None and args.fleet_heartbeat <= 0:
         raise SystemExit("--fleet-heartbeat must be > 0 seconds")
     if not 0.0 <= args.trace_sample <= 1.0:
@@ -415,6 +481,47 @@ def run(args) -> dict:
         )
 
     cfg = _apply_overrides(get_config(args.config), args)
+
+    if args.replay_shards and not args.actors:
+        print(
+            "replay-shards: no fleet (--actors 0) — replay stays in the "
+            "central device arena and the phase-locked schedule runs "
+            "unchanged (the determinism anchor, docs/REPLAY.md)",
+            flush=True,
+        )
+    replay_capacity = cfg.trainer.capacity
+    if args.replay_shards and args.actors:
+        reachable = (replay_capacity // args.replay_shards) * min(
+            args.actors, args.replay_shards
+        )
+        if cfg.trainer.min_replay > reachable:
+            # The absorb gate waits for min_replay resident sequences,
+            # but only min(actors, shards) shards ever receive traffic:
+            # an unreachable gate would die after idle_timeout with a
+            # misleading "starved" error against a healthy fleet.
+            raise SystemExit(
+                f"--replay-shards: min_replay {cfg.trainer.min_replay} "
+                f"exceeds the reachable shard occupancy {reachable} "
+                f"({args.actors} actors feed min(actors, shards) of "
+                f"{args.replay_shards} shards x "
+                f"{replay_capacity // args.replay_shards} slots) — "
+                f"lower --min-replay or --replay-shards"
+            )
+        # Sampler mode: replay lives in the host-side ingest shards
+        # (which get ``replay_capacity``, captured above), so the
+        # trainer's device arena is structural only — shrink it to a
+        # token allocation instead of reserving the config's full
+        # capacity in HBM for buffers that stay init-zeros.  min_replay
+        # is untouched (it gates the sampler's absorb phase).
+        import dataclasses as _dc
+
+        cfg = _dc.replace(
+            cfg,
+            trainer=_dc.replace(
+                cfg.trainer,
+                capacity=max(cfg.trainer.num_envs, cfg.trainer.batch_size),
+            ),
+        )
 
     if args.spmd:
         from r2d2dpg_tpu.parallel import make_mesh
@@ -542,7 +649,7 @@ def run(args) -> dict:
     if args.actors:
         return _run_fleet(
             trainer, cfg, state, logger, ckpt, args, watchdog, flight,
-            flight_path,
+            flight_path, replay_capacity=replay_capacity,
         )
 
     warm = trainer.window_fill_phases
@@ -806,7 +913,8 @@ def _run_pipelined(
 
 
 def _run_fleet(
-    trainer, cfg, state, logger, ckpt, args, watchdog, flight, flight_path
+    trainer, cfg, state, logger, ckpt, args, watchdog, flight, flight_path,
+    replay_capacity=None,
 ) -> dict:
     """Drive the run through the actor fleet (--actors N, docs/FLEET.md).
 
@@ -871,28 +979,50 @@ def _run_fleet(
         if args.fleet_heartbeat is not None
         else fleet_transport.READ_DEADLINE_S
     )
-    learner = FleetLearner(
-        trainer,
-        FleetConfig(
-            num_actors=args.actors,
-            address=args.fleet_address,
-            queue_depth=args.fleet_queue_depth,
-            publish_every=args.fleet_publish_every,
-            idle_timeout_s=args.fleet_idle_timeout,
-            shed_after_s=(
-                args.fleet_shed_after
-                if args.fleet_shed_after is not None
-                else 1.0
-            ),
-            wire=wire_config,
-            drain_coalesce=args.drain_coalesce,
-            heartbeat_s=heartbeat_s,
-            auth_token=fleet_token,
+    fleet_config = FleetConfig(
+        num_actors=args.actors,
+        address=args.fleet_address,
+        queue_depth=args.fleet_queue_depth,
+        publish_every=args.fleet_publish_every,
+        idle_timeout_s=args.fleet_idle_timeout,
+        shed_after_s=(
+            args.fleet_shed_after
+            if args.fleet_shed_after is not None
+            else 1.0
         ),
+        wire=wire_config,
+        drain_coalesce=args.drain_coalesce,
+        heartbeat_s=heartbeat_s,
+        auth_token=fleet_token,
     )
+    if args.replay_shards:
+        # In-network sampling (docs/REPLAY.md): replay shards at the
+        # ingest edge, learner pulls batches.  The shards own the
+        # experiment's REAL replay capacity — captured by run() BEFORE
+        # it shrank the trainer's unused device arena (one config
+        # resolution, no chance to desynchronize).
+        from r2d2dpg_tpu.fleet.sampler import SamplerLearner
+
+        try:
+            learner = SamplerLearner(
+                trainer,
+                fleet_config,
+                num_shards=args.replay_shards,
+                total_capacity=replay_capacity,
+            )
+        except ValueError as e:
+            raise SystemExit(f"--replay-shards: {e}")
+    else:
+        learner = FleetLearner(trainer, fleet_config)
     address = learner.start()
     print(
-        f"fleet: ingest on {address}; spawning {args.actors} actors",
+        f"fleet: ingest on {address}; spawning {args.actors} actors"
+        + (
+            f"; {args.replay_shards} replay shards (learner-pulled "
+            f"sampling)"
+            if args.replay_shards
+            else ""
+        ),
         flush=True,
     )
     # Learner recovery (docs/FLEET.md "Failure modes"): resume restores
@@ -948,7 +1078,11 @@ def _run_fleet(
         # The ~1 Hz TELEM cadence: every actor's registry lands in THIS
         # process's /metrics under actor=/host= labels (ISSUE 6).
         extra += ["--telem-every", "1.0"]
-    if args.trace_sample:
+    if args.trace_sample and not args.replay_shards:
+        # Sharded ingest drops every SEQS trace sidecar (the sampler
+        # records its own sample_req -> batch_return -> learn chain via
+        # run_kwargs below), so forwarding the rate to actors there
+        # would buy 32 wasted wire bytes per sampled frame and nothing.
         extra += ["--trace-sample", str(args.trace_sample)]
     # Liveness: one deadline per fleet, both wire ends (docs/FLEET.md).
     extra += ["--read-deadline", str(heartbeat_s)]
@@ -1009,6 +1143,12 @@ def _run_fleet(
     final: dict = {}
     metrics_fn = _make_executor_metrics_fn(logger, watchdog, final)
 
+    run_kwargs = {}
+    if args.replay_shards:
+        # The sampler learner records its own trace hops (sample_req ->
+        # batch_return -> learn); the central drain's hops ride the SEQS
+        # sidecar instead, so only the sampler takes the rate directly.
+        run_kwargs["trace_sample"] = args.trace_sample
     try:
         supervisor.start()
         state = learner.run(
@@ -1021,6 +1161,7 @@ def _run_fleet(
             checkpoint_every=args.checkpoint_every,
             resume_from=resume_from,
             phase_fn=engine.on_phase if engine is not None else None,
+            **run_kwargs,
         )
         _fold_executor_stats("fleet", learner.stats(), final)
         final["fleet_actor_restarts"] = float(supervisor.restarts_total)

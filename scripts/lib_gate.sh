@@ -144,6 +144,10 @@ run_evidence() {
         echo "$dir: learner-dp determinism gate FAILED (attempt $attempt)"
         continue
       fi
+      if ! sampler_gate "$dir" "$@"; then
+        echo "$dir: sampler equivalence gate FAILED (attempt $attempt)"
+        continue
+      fi
       timeout --kill-after=30 --signal=TERM 1800 \
         env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
         python -m r2d2dpg_tpu.eval $evalflags \
@@ -312,6 +316,52 @@ learner_dp_gate() {
          -k determinism \
        > "$dir/learner_dp_gate.log" 2>&1; then
     touch "$dir/.learner_dp_determinism_ok"
+    return 0
+  fi
+  return 1
+}
+
+# Sampler evidence gate (ISSUE 10): a run dir trained with
+# --replay-shards N may only be blessed (.done) if the in-network-
+# sampling anchors pass on this checkout — the --replay-shards 1
+# --actors 0 CLI path bit-identical to Trainer.run (wiring the knob
+# changes no bit of the default schedule) AND the two-level sharded
+# draw distribution-equivalent to central proportional sampling on
+# exact-integer priorities (docs/REPLAY.md "Determinism anchor").  The
+# resolved shard count is stamped into the evidence dir
+# (replay_shards.txt) beside fleet_wire.txt, so a blessed number always
+# says which replay topology produced it.  Same stamping discipline as
+# fleet_gate; non-sharded runs pass through untouched.
+#   sampler_gate <dir> <train args...>
+sampler_gate() {
+  local dir=$1
+  shift
+  local _rs="" _rs_prev=""
+  local _rs_arg
+  for _rs_arg in "$@"; do
+    # Both argparse spellings: "--flag value" and "--flag=value".
+    case "$_rs_arg" in
+      --replay-shards=*) _rs=${_rs_arg#*=} ;;
+    esac
+    case "$_rs_prev" in
+      --replay-shards) _rs=$_rs_arg ;;
+    esac
+    _rs_prev=$_rs_arg
+  done
+  if [ -z "$_rs" ] || [ "$_rs" = 0 ]; then
+    return 0  # not a sharded-replay run: nothing to gate
+  fi
+  printf 'replay_shards=%s\n' "$_rs" > "$dir/replay_shards.txt"
+  if [ -f "$dir/.sampler_equivalence_ok" ]; then
+    return 0
+  fi
+  if timeout --kill-after=30 900 \
+       env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+       XLA_FLAGS= \
+       python -m pytest tests/test_sampler.py -q -p no:cacheprovider \
+         -k 'determinism or equivalence' \
+       > "$dir/sampler_gate.log" 2>&1; then
+    touch "$dir/.sampler_equivalence_ok"
     return 0
   fi
   return 1

@@ -123,6 +123,18 @@ def test_parse_chaos_spec_grammar():
     assert [f.index for f in faults] == [0, 1, 2, 3]
 
 
+def test_parse_chaos_spec_sampler_faults():
+    """The sampler peer class (ISSUE 10): kill_sampler_conn (no
+    duration) and stall_sampler (duration required) parse as
+    learner-side faults."""
+    from r2d2dpg_tpu.fleet.chaos import LEARNER_FAULTS
+
+    faults = parse_chaos_spec("kill_sampler_conn@p2,stall_sampler@p3:1s")
+    assert [f.kind for f in faults] == ["kill_sampler_conn", "stall_sampler"]
+    assert faults[1].duration_s == 1.0
+    assert {"kill_sampler_conn", "stall_sampler"} <= LEARNER_FAULTS
+
+
 @pytest.mark.parametrize(
     "bad",
     [
@@ -133,6 +145,8 @@ def test_parse_chaos_spec_grammar():
         "kill_actor@p0",
         "kill_actor@p2:3s",  # duration on a non-stall fault
         "stall_actor@p2",  # stall without a duration
+        "kill_sampler_conn@p2:3s",  # duration on a non-stall fault
+        "stall_sampler@p2",  # stall without a duration
         "kill_actor@p1,,kill_actor@p2",
     ],
 )
@@ -610,6 +624,9 @@ def test_chaos_multi_fault_drill_in_process_e2e(tmp_path):
     )
     n_train = 8
     rows = []
+    # The flight ring is global across tests (other drills leave their
+    # own chaos_inject lines behind): only events from OUR run count.
+    n0 = len(get_flight_recorder().events())
     for t in threads:
         t.start()
     try:
@@ -620,6 +637,15 @@ def test_chaos_multi_fault_drill_in_process_e2e(tmp_path):
             metrics_fn=lambda p, s: rows.append((p, dict(s))),
             phase_fn=engine.on_phase,
         )
+        # The queue backlog lets the learner burn its remaining phases in
+        # milliseconds after the SIGKILL drill, so on a fast box the run
+        # can end BEFORE the ~0.1 s backoff restart lands — and teardown
+        # stops the supervisor, erasing the recovery this test asserts.
+        # Hold the fleet up until the restart is observable.
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and sup.restarts_total < 1:
+            time.sleep(0.05)
+        time.sleep(0.1)  # let the restart's flight event land too
     finally:
         sup.stop()
         learner.close()
@@ -638,7 +664,7 @@ def test_chaos_multi_fault_drill_in_process_e2e(tmp_path):
     assert stats["sheds"] == 0
 
     # 3. Every injected fault paired with its documented recovery.
-    events = get_flight_recorder().events()
+    events = get_flight_recorder().events()[n0:]
     injected = {
         (e["fault"], e["actor"])
         for e in events
@@ -685,6 +711,132 @@ def test_chaos_multi_fault_drill_in_process_e2e(tmp_path):
     for kind in ("kill_actor", "stall_actor", "corrupt_frame",
                  "kill_ingest_conn"):
         assert fired.get(kind, 0) >= 1
+
+
+def test_chaos_sampler_drills_in_process_e2e():
+    """The sampler peer class's drills (ISSUE 10): a live 2-actor
+    2-shard sampler fleet under ``stall_sampler`` + ``kill_sampler_conn``.
+
+    What the drills pin (docs/REPLAY.md "Recovery contract"):
+
+    - ``stall_sampler`` — the pull loop sleeps, and NOTHING downstream
+      degrades: shards keep absorbing under their own locks (no central
+      drain to back up), so actors neither shed nor get reaped — the
+      run completes with sheds == 0 and zero peer_dead events.
+    - ``kill_sampler_conn`` — the connection FEEDING a shard dies; the
+      actor reconnects (fresh HELLO) onto the SAME consistent-hash
+      shard, whose data survives, and the at-least-once accounting
+      re-banks the in-flight deltas: env-step counters stay monotone,
+      so a dead shard feed loses only re-collectable experience.
+    """
+    from r2d2dpg_tpu.fleet import FleetConfig, SamplerLearner
+    from r2d2dpg_tpu.fleet.actor import FleetActor
+    from r2d2dpg_tpu.configs import PENDULUM_TINY
+
+    seed = 0
+    num_actors = 2
+    spec = "stall_sampler@p2:1s,kill_sampler_conn@p3"
+    faults = parse_chaos_spec(spec)
+    trainer = PENDULUM_TINY.build()
+    learner = SamplerLearner(
+        trainer,
+        FleetConfig(num_actors=num_actors, idle_timeout_s=120),
+        num_shards=2,
+    )
+    address = learner.start()
+    actors = [
+        FleetActor(
+            PENDULUM_TINY,
+            actor_id=i,
+            num_actors=num_actors,
+            address=address,
+            seed=seed,
+            reconnect_tries=8,
+            reconnect_base_s=0.1,
+            reconnect_max_s=0.5,
+        )
+        for i in range(num_actors)
+    ]
+
+    def actor_loop(a):
+        try:
+            # Unpaced on purpose: sampler-mode acks never block (ring
+            # eviction replaces backpressure), so a phase-capped actor
+            # would sprint through its budget during the learner's
+            # compile and exit before the drills fire — stream until the
+            # server teardown cuts the socket.
+            a.run()
+        except Exception:  # noqa: BLE001 — server teardown cuts the socket
+            pass
+
+    threads = [
+        threading.Thread(target=actor_loop, args=(a,), daemon=True)
+        for a in actors
+    ]
+    engine = ChaosEngine(
+        faults,
+        seed=seed,
+        num_actors=num_actors,
+        server=learner.server,
+    )
+    n0 = len(get_flight_recorder().events())
+    n_train = 6
+    rows = []
+    for t in threads:
+        t.start()
+    try:
+        state = learner.run(
+            n_train,
+            log_every=1,
+            metrics_fn=lambda p, s: rows.append((p, dict(s))),
+            phase_fn=engine.on_phase,
+        )
+        # The free-running sampler finishes its phases in milliseconds;
+        # hold the server open until the dropped actor's reconnect (its
+        # backoff is ~0.1 s) lands, so the recovery is observable.
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not any(
+            e["kind"] == "actor_reconnect"
+            for e in get_flight_recorder().events()[n0:]
+        ):
+            time.sleep(0.05)
+    finally:
+        learner.close()
+        for t in threads:
+            t.join(timeout=30)
+
+    # The run completed its exact schedule despite both faults.
+    assert int(state.train.step) == n_train * trainer.config.learner_steps
+    stats = learner.stats()
+    assert stats["train_phases"] == n_train
+    assert not engine.unfired()
+    # Monotone accounting, structurally zero sheds.
+    env_steps = [s["env_steps"] for _, s in rows]
+    assert env_steps == sorted(env_steps) and env_steps[-1] > 0
+    assert stats["sheds"] == 0
+    events = get_flight_recorder().events()[n0:]
+    injected = {
+        e["fault"] for e in events if e["kind"] == "chaos_inject"
+    }
+    assert injected == {"stall_sampler", "kill_sampler_conn"}
+    # The stall recorded its duration and reaped NOBODY (ring eviction,
+    # not queue backpressure, absorbs a stalled sampler).
+    stall = next(
+        e for e in events
+        if e["kind"] == "chaos_inject" and e["fault"] == "stall_sampler"
+    )
+    assert stall.get("duration_s") == 1.0
+    assert not [e for e in events if e["kind"] == "peer_dead"]
+    # The conn drop named its victim and the actor reconnected; the
+    # victim's shard kept its data (occupancy never collapsed to the
+    # other shard alone — the run finished sampling from BOTH whenever
+    # both advertise, which monotone env steps + completion imply).
+    drop = next(
+        e for e in events
+        if e["kind"] == "chaos_inject" and e["fault"] == "kill_sampler_conn"
+    )
+    assert drop.get("dropped") is not None
+    assert any(e["kind"] == "actor_reconnect" for e in events)
 
 
 # ------------------------------------------------------------- slow soaks

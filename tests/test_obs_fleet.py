@@ -37,7 +37,7 @@ from r2d2dpg_tpu.fleet.transport import (
     send_frame_parts,
     unpack_obj,
 )
-from r2d2dpg_tpu.obs.trace import HOPS
+from r2d2dpg_tpu.obs.trace import WIRE_HOPS
 from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
 from r2d2dpg_tpu.utils.codes import OK
 
@@ -280,7 +280,7 @@ def test_fleet_obs_plane_two_actor_e2e(tmp_path):
     # One TYPE line per family even with two actors folded in.
     assert text.count("# TYPE r2d2dpg_actor_phases_total") == 1
     # The per-hop histograms are scrapeable alongside.
-    for hop in HOPS:
+    for hop in WIRE_HOPS:
         assert f"r2d2dpg_trace_{hop}_seconds" in text, hop
 
     # --- leg 2: sampled spans cover all hops and add up -----------------
@@ -289,15 +289,15 @@ def test_fleet_obs_plane_two_actor_e2e(tmp_path):
     for s in spans:
         by_id.setdefault(s["trace_id"], {})[s["hop"]] = s
     complete = [
-        tid for tid, hops in by_id.items() if set(HOPS) <= set(hops)
+        tid for tid, hops in by_id.items() if set(WIRE_HOPS) <= set(hops)
     ]
     assert complete, f"no complete trace; hops seen: {by_id and set().union(*[set(h) for h in by_id.values()])}"
     # All-or-nothing recording: absorb-phase/shed batches contribute NO
     # partial chain, so every recorded trace id carries all 8 hops and
     # every hop histogram shares one sample population.
-    assert all(set(hops) == set(HOPS) for hops in by_id.values()), {
+    assert all(set(hops) == set(WIRE_HOPS) for hops in by_id.values()), {
         tid: sorted(hops) for tid, hops in by_id.items()
-        if set(hops) != set(HOPS)
+        if set(hops) != set(WIRE_HOPS)
     }
     # The hops are contiguous intervals, so per-hop durations must sum to
     # the observed end-to-end latency of that batch (~10%: the learner-wait
@@ -318,7 +318,7 @@ def test_fleet_obs_plane_two_actor_e2e(tmp_path):
     assert fr.dump_trace(path) == path
     doc = json.loads(open(path).read())
     names = {e["name"] for e in doc["traceEvents"]}
-    assert set(HOPS) <= names
+    assert set(WIRE_HOPS) <= names
     assert all(
         e["ph"] == "X" and "ts" in e and "dur" in e and "pid" in e
         for e in doc["traceEvents"]

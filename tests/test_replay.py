@@ -189,6 +189,136 @@ def test_dp_sharded_arena_layout_and_per_shard_occupancy():
         arena.per_shard_occupancy(state, 3)
 
 
+# ------------------------------------------- sharded replay (ISSUE 10)
+def _np_batch(b, start=0.0):
+    return SequenceBatch(
+        obs=np.zeros((b, L, OBS), np.float32),
+        action=np.zeros((b, L, ACT), np.float32),
+        reward=(start + np.arange(b, dtype=np.float32))[:, None]
+        * np.ones((b, L), np.float32),
+        discount=np.ones((b, L), np.float32),
+        reset=np.zeros((b, L), np.float32),
+        carries={},
+    )
+
+
+def test_two_level_sharded_sampling_matches_central_distribution():
+    """ISSUE 10 fidelity anchor: exact-integer priorities spread over 2
+    shards, alpha=1 — the two-level draw (shards ∝ Σp, within-shard
+    proportional) and the central ``ReplayArena.sample`` converge to the
+    SAME p/Σp distribution over many draws, and the combined two-level
+    probabilities equal the central per-draw probabilities exactly."""
+    from r2d2dpg_tpu.replay.sharded import (
+        ReplayShard,
+        combine_probs,
+        shard_quotas,
+    )
+
+    prios = np.array([1.0, 2.0, 3.0, 6.0], np.float64)
+    # Central reference: empirical frequency from the device arena.
+    arena = ReplayArena(capacity=4, alpha=1.0)
+    state = arena.init_state(make_batch(4))
+    state = arena.add(state, make_batch(4), jnp.asarray(prios))
+    n_draws, bsz = 200, 64
+    sample = jax.jit(lambda s, k: arena.sample(s, k, bsz).indices)
+    central = np.zeros(4)
+    for k in jax.random.split(jax.random.PRNGKey(0), n_draws):
+        idx, c = np.unique(np.asarray(sample(state, k)), return_counts=True)
+        central[idx] += c
+    central /= central.sum()
+
+    # Sharded: priorities 1,2 on shard 0 and 3,6 on shard 1; reward row
+    # value identifies the slot globally.
+    shards = [ReplayShard(4, alpha=1.0, shard_id=i) for i in range(2)]
+    shards[0].add(_np_batch(2, start=0.0), prios[:2])
+    shards[1].add(_np_batch(2, start=2.0), prios[2:])
+    rng = np.random.default_rng(1)
+    sums = np.array([s.scaled_sum() for s in shards])
+    total = float(sums.sum())
+    counts = np.zeros(4)
+    for _ in range(n_draws):
+        quotas = shard_quotas(sums, bsz, rng)
+        for sid, q in enumerate(quotas):
+            if q == 0:
+                continue
+            s = shards[sid].sample(int(q), rng)
+            keys = s.seq.reward[:, 0].astype(int)
+            np.testing.assert_allclose(  # combined == central p/Σ, exact
+                combine_probs(s.probs, float(sums[sid]), total),
+                prios[keys] / prios.sum(),
+                rtol=1e-12,
+            )
+            np.add.at(counts, keys, 1)
+    sharded = counts / counts.sum()
+    want = prios / prios.sum()
+    np.testing.assert_allclose(sharded, want, atol=0.02)
+    np.testing.assert_allclose(central, want, atol=0.02)
+    np.testing.assert_allclose(sharded, central, atol=0.03)
+
+
+def test_shard_priority_write_back_roundtrip_and_stale_version_ignored():
+    """Write-back is keyed (slot, generation): a verdict about a
+    sequence the ring has since evicted must NOT clobber the newer
+    occupant's priority — stale versions are ignored, like param
+    regressions (docs/REPLAY.md 'Write-back versioning')."""
+    from r2d2dpg_tpu.replay.sharded import ReplayShard
+
+    s = ReplayShard(4, alpha=1.0)
+    s.add(_np_batch(4), np.array([1.0, 1.0, 1.0, 1.0]))
+    rng = np.random.default_rng(0)
+    sam = s.sample(4, rng)
+    # Fresh handles: every entry applies; the sum moves accordingly.
+    applied = s.update_priorities(
+        sam.slots, sam.gens, np.full(4, 3.0)
+    )
+    assert applied == 4
+    hit = np.unique(sam.slots)
+    assert s.priority_sum() == 3.0 * len(hit) + 1.0 * (4 - len(hit))
+    # Overwrite two slots (ring wrap bumps their generations) …
+    before = s.sample(4, rng)  # handles from the OLD generation
+    s.add(_np_batch(2, start=10.0), np.array([2.0, 2.0]))
+    psum = s.priority_sum()
+    # … a stale write-back touches only the un-overwritten slots.
+    stale_mask = np.isin(before.slots, [0, 1])
+    applied = s.update_priorities(
+        before.slots, before.gens, np.full(4, 100.0)
+    )
+    assert applied == int((~stale_mask).sum())
+    # The overwritten slots' fresh 2.0 priorities survived untouched.
+    assert s._priority[0] == 2.0 and s._priority[1] == 2.0
+    if stale_mask.all():
+        assert s.priority_sum() == psum
+
+
+def test_shard_ring_eviction_semantics():
+    """The shard ring is FIFO over capacity: occupancy caps, the oldest
+    rows are the evicted ones, generations bump per overwrite, and
+    total_added stays monotone (the 'a dead shard loses only
+    re-collectable experience' accounting base)."""
+    from r2d2dpg_tpu.replay.sharded import ReplayShard
+
+    s = ReplayShard(4, alpha=1.0)
+    for i in range(6):  # 6 adds into capacity 4 -> rows 2..5 survive
+        s.add(_np_batch(1, start=float(i)), np.array([1.0]))
+    assert s.occupancy() == 4 and s.total_added == 6
+    rows = sorted(s._data.reward[:, 0].tolist())
+    assert rows == [2.0, 3.0, 4.0, 5.0]
+    # Slots 0,1 were written twice (generation 2), 2,3 once.
+    np.testing.assert_array_equal(s._generation, [2, 2, 1, 1])
+    # None priorities enter at the shard max (the central "max" entry
+    # semantics) with floor 1.0.
+    s.update_priorities(np.array([2]), np.array([1]), np.array([7.0]))
+    s.add(_np_batch(1, start=9.0), None)
+    assert s._priority[2] == 7.0  # untouched slot keeps its rank
+    assert s._priority[s._cursor - 1] == 7.0  # new row entered at max
+    # An empty shard refuses to sample (quotas never route draws there).
+    import pytest as _pytest
+
+    empty = ReplayShard(2, alpha=1.0)
+    with _pytest.raises(ValueError, match="empty"):
+        empty.sample(1, np.random.default_rng(0))
+
+
 def test_sampled_batch_contents_roundtrip():
     arena = ReplayArena(capacity=16)
     state = arena.init_state(make_batch(4))

@@ -1,0 +1,488 @@
+"""In-network experience sampling (ISSUE 10): sharded replay at the
+ingest edge, learner-pulled batches (fleet/sampler.py + replay/sharded.py).
+
+Anchors ``scripts/lib_gate.sh sampler_gate`` enforces before blessing
+``--replay-shards N`` evidence dirs:
+
+- **determinism** — ``--replay-shards 1 --actors 0`` routes the untouched
+  phase-locked loop, pinned BIT-identical to ``Trainer.run`` end to end
+  through the train.py CLI (docs/REPLAY.md "Determinism anchor").
+- **equivalence** — the two-level draw (shards ∝ Σp^α, within-shard
+  proportional) through the REAL SAMPLE_REQ/BATCH frame codecs matches
+  the central proportional distribution on exact-integer priorities.
+"""
+
+import queue
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.configs import PENDULUM_TINY
+from r2d2dpg_tpu.fleet import (
+    FleetConfig,
+    SamplerLearner,
+    ShardSet,
+    shard_for_actor,
+    transport,
+    wire,
+)
+from r2d2dpg_tpu.fleet.ingest import IngestServer
+from r2d2dpg_tpu.fleet.transport import (
+    K_ACK,
+    K_HELLO,
+    K_SEQS,
+    pack_hello,
+    recv_frame,
+    send_frame,
+    send_frame_parts,
+    unpack_obj,
+)
+from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
+from r2d2dpg_tpu.replay.sharded import shard_quotas
+from r2d2dpg_tpu.utils.codes import OK
+
+pytestmark = pytest.mark.sampler
+
+N_TRAIN = 6
+LOG_EVERY = 2
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return [
+        i
+        for i, (x, y) in enumerate(zip(la, lb))
+        if not np.array_equal(np.asarray(x), np.asarray(y))
+    ]
+
+
+def _np_staged(b=2, l=3, prios=None):
+    rng = np.random.default_rng(1)
+    return StagedSequences(
+        seq=SequenceBatch(
+            obs=rng.normal(size=(b, l, 3)).astype(np.float32),
+            action=rng.normal(size=(b, l, 1)).astype(np.float32),
+            reward=rng.normal(size=(b, l)).astype(np.float32),
+            discount=np.ones((b, l), np.float32),
+            reset=np.zeros((b, l), np.float32),
+            carries={},
+        ),
+        priorities=prios,
+    )
+
+
+# ------------------------------------------------------- determinism anchor
+def test_replay_shards_off_determinism_bit_identical(tmp_path):
+    """--replay-shards 1 --actors 0 == the untouched phase-locked
+    Trainer.run, leaf-for-leaf bitwise, end to end through the train.py
+    CLI (parse -> guards -> loop -> final checkpoint) — the sampler_gate
+    anchor: wiring the knob in changes no bit of the default schedule."""
+    from r2d2dpg_tpu import train
+    from r2d2dpg_tpu.utils import CheckpointManager
+    from r2d2dpg_tpu.utils.checkpoint import resume_state
+
+    t1 = PENDULUM_TINY.build()
+    warm, fill = t1.window_fill_phases, t1.replay_fill_phases
+    s1 = t1.run(
+        warm + fill + N_TRAIN, log_every=LOG_EVERY, log_fn=lambda *_: None
+    )
+
+    train.run(
+        train.parse_args(
+            [
+                "--config", "pendulum_tiny",
+                "--actors", "0",
+                "--replay-shards", "1",
+                "--phases", str(N_TRAIN),
+                "--log-every", str(LOG_EVERY),
+                "--checkpoint-dir", str(tmp_path / "ckpt"),
+                "--checkpoint-every", "-1",
+                "--watchdog", "0",
+            ]
+        )
+    )
+    t2 = PENDULUM_TINY.build()
+    s2 = resume_state(
+        t2, CheckpointManager(str(tmp_path / "ckpt"), save_every=-1)
+    )
+    bad = _leaves_equal(s1, s2)
+    assert not bad, f"state diverged at leaves {bad}"
+
+
+# ---------------------------------------------------- sampling equivalence
+def test_two_level_frame_path_sampling_equivalence():
+    """The sampling-equivalence anchor: draws through the FULL sampler
+    machinery — ShardSet routing, SAMPLE_REQ/BATCH codec roundtrips,
+    two-level quotas, combined probabilities — reproduce the central
+    proportional distribution ``p^alpha / sum`` on exact-integer
+    priorities, and the combined probs are exactly the central ones."""
+    from r2d2dpg_tpu.replay.sharded import combine_probs
+
+    prios = np.array([1.0, 2.0, 3.0, 6.0, 4.0, 8.0], np.float64)
+    shards = ShardSet(2, 8, alpha=1.0, prioritized=True)
+    # Rows land per shard: shard 0 gets [1,2,3], shard 1 gets [6,4,8] —
+    # reward row value identifies the slot globally.
+    for shard_id, block in ((0, prios[:3]), (1, prios[3:])):
+        seq = _np_staged(b=3).seq
+        seq = SequenceBatch(
+            obs=seq.obs,
+            action=seq.action,
+            reward=np.repeat(
+                block.astype(np.float32)[:, None], seq.reward.shape[1], 1
+            ),
+            discount=seq.discount,
+            reset=seq.reset,
+            carries={},
+        )
+        shards.shards[shard_id].add(seq, block)
+
+    packer = wire.TreePacker(wire.WireConfig())
+    unpacker = wire.TreeUnpacker()
+    rng = np.random.default_rng(0)
+    counts: dict = {}
+    n_rounds, per_round = 300, 32
+    total = float(shards.scaled_sums().sum())
+    for _ in range(n_rounds):
+        quotas = shard_quotas(shards.scaled_sums(), per_round, rng)
+        for shard_id, quota in enumerate(quotas):
+            if quota == 0:
+                continue
+            req = wire.unpack_sample_req(
+                unpacker.unpack(
+                    b"".join(
+                        bytes(p)
+                        for p in wire.pack_sample_req(
+                            packer, req_id=1, shard=shard_id, quota=int(quota)
+                        )
+                    )
+                )
+            )
+            shard = shards.shards[req["shard"]]
+            s = shard.sample(req["quota"], rng)
+            resp = wire.unpack_shard_batch(
+                unpacker.unpack(
+                    b"".join(
+                        bytes(p)
+                        for p in wire.pack_shard_batch(
+                            packer,
+                            req_id=1,
+                            shard=shard_id,
+                            staged=StagedSequences(seq=s.seq, priorities=None),
+                            slots=s.slots,
+                            gens=s.gens,
+                            probs=s.probs,
+                            priority_sum=shard.scaled_sum(),
+                            occupancy=shard.occupancy(),
+                        )
+                    )
+                )
+            )
+            # Combined two-level probability == central p/sum, exactly
+            # (integer priorities: no float reassociation headroom).
+            got = combine_probs(
+                resp["probs"], shards.shards[shard_id].scaled_sum(), total
+            )
+            keys = resp["staged"].seq.reward[:, 0].astype(np.float64)
+            np.testing.assert_allclose(got, keys / prios.sum(), rtol=1e-12)
+            for k in keys:
+                counts[float(k)] = counts.get(float(k), 0) + 1
+    draws = n_rounds * per_round
+    freq = np.array([counts.get(float(p), 0) / draws for p in prios])
+    np.testing.assert_allclose(freq, prios / prios.sum(), atol=0.02)
+
+
+def test_shard_quotas_and_routing():
+    rng = np.random.default_rng(3)
+    q = shard_quotas([0.0, 2.0, 6.0], 1000, rng)
+    assert q.sum() == 1000 and q[0] == 0  # empty shards get no draws
+    np.testing.assert_allclose(q[2] / 1000, 0.75, atol=0.05)
+    with pytest.raises(ValueError, match="empty"):
+        shard_quotas([0.0, 0.0], 8, rng)
+    # Routing is a pure consistent hash: stable per actor id, in range,
+    # identical across calls (a reconnecting actor keeps its shard).
+    for n in (1, 2, 5):
+        for a in range(8):
+            r = shard_for_actor(a, n)
+            assert 0 <= r < n and r == shard_for_actor(a, n)
+    assert shard_for_actor("7", 4) == shard_for_actor(7, 4)  # HELLO strs
+
+
+# ----------------------------------------------------------- ingest routing
+def test_ingest_routes_seqs_into_shards_and_never_sheds():
+    """Sharded mode: SEQS go straight to the actor's shard (no staging
+    queue), acks are ALWAYS ok (ring eviction replaces shedding — more
+    batches than a queue could hold are absorbed without one shed), and
+    the accounting deltas land in the bank."""
+    shards = ShardSet(2, 8, alpha=0.6)
+    q: queue.Queue = queue.Queue(maxsize=1)  # would overflow after 1
+    srv = IngestServer(q, address="127.0.0.1:0", shards=shards)
+    srv.start()
+    try:
+        sock = transport.connect(srv.address)
+        sock.settimeout(10)
+        packer = wire.TreePacker(wire.WireConfig())
+        send_frame(
+            sock,
+            K_HELLO,
+            pack_hello(
+                {"actor_id": 5, **wire.negotiation_fields(wire.WireConfig())}
+            ),
+        )
+        recv_frame(sock)  # hello ack
+        for phase in range(6):  # 6 batches past a depth-1 queue: no sheds
+            send_frame_parts(
+                sock,
+                K_SEQS,
+                packer.pack(
+                    {
+                        "phase": phase,
+                        "param_version": 0,
+                        "env_steps_delta": 8.0,
+                        "ep_return_sum": -1.0,
+                        "ep_count": 1.0,
+                        "staged": _np_staged(
+                            prios=np.array([1.0, 2.0], np.float32)
+                        ),
+                    }
+                ),
+            )
+            kind, payload = recv_frame(sock)
+            assert kind == K_ACK and unpack_obj(payload)["code"] == OK
+        sock.close()
+        assert srv.shed_total == 0 and q.qsize() == 0
+        target = shards.route("5")
+        assert shards.shards[target].total_added == 12
+        assert shards.shards[1 - target].total_added == 0
+        assert shards.shards[target].occupancy() == 8  # ring capped
+        stats = shards.pop_stats()
+        assert stats["env_steps_delta"] == 48.0 and stats["ep_count"] == 6.0
+        assert shards.pop_stats()["env_steps_delta"] == 0.0  # drained
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------- learner e2e
+def test_sampler_learner_end_to_end_thread_actor():
+    """A real FleetActor streaming into a 2-shard sampler learner: the
+    run completes its exact step schedule, only sampled sequences cross
+    the sampling boundary (bytes accounted), priorities get written back
+    (the fed shard's priority sum moves off the actor's initial ranks),
+    and nothing sheds."""
+    from r2d2dpg_tpu.fleet.actor import FleetActor
+
+    trainer = PENDULUM_TINY.build()
+    learner = SamplerLearner(
+        trainer,
+        FleetConfig(num_actors=1, idle_timeout_s=60),
+        num_shards=2,
+    )
+    address = learner.start()
+    actor = FleetActor(
+        PENDULUM_TINY, actor_id=0, num_actors=1, address=address, seed=0
+    )
+
+    def actor_loop():
+        try:
+            # Unpaced on purpose: sampler-mode acks never block (ring
+            # eviction replaces backpressure), so a phase-capped actor
+            # would sprint through its budget during the learner's
+            # compile and exit before the run ends — stream until the
+            # server teardown cuts the socket.
+            actor.run()
+        except Exception:  # noqa: BLE001 — server teardown cuts the socket
+            pass
+
+    thread = threading.Thread(target=actor_loop, daemon=True)
+    thread.start()
+    logged = []
+    try:
+        state = learner.run(
+            N_TRAIN,
+            log_every=LOG_EVERY,
+            metrics_fn=lambda p, s: logged.append((p, dict(s))),
+        )
+    finally:
+        learner.close()
+        thread.join(timeout=30)
+    tc = trainer.config
+    assert int(state.train.step) == N_TRAIN * tc.learner_steps
+    stats = learner.stats()
+    assert stats["train_phases"] == N_TRAIN
+    assert stats["sheds"] == 0
+    n_draws = N_TRAIN * tc.learner_steps * tc.batch_size
+    assert stats["trained_seqs"] == n_draws
+    assert stats["replay_occupancy"] >= tc.min_replay
+    # The sampling boundary carried REQ+BATCH+PRIO for exactly the
+    # trained draws — orders of magnitude under the collected stream.
+    assert 0 < stats["bytes_per_trained_seq"] < stats["seqs_bytes_total"]
+    assert stats["sample_bytes_total"] < stats["seqs_bytes_total"]
+    assert [p for p, _ in logged] == [
+        p for p in range(1, N_TRAIN + 1) if p % LOG_EVERY == 0
+    ]
+    for _, scalars in logged:
+        assert "env_steps" in scalars and "learner_steps" in scalars
+    # env-step accounting stayed monotone through the bank.
+    env_steps = [s["env_steps"] for _, s in logged]
+    assert env_steps == sorted(env_steps) and env_steps[-1] > 0
+
+
+def test_sampler_learner_checkpoint_resume_in_process(tmp_path):
+    """The recovery contract (docs/REPLAY.md): run 4 pull phases with
+    periodic checkpoints, abandon the learner, resume a FRESH one from
+    the checkpoint + counter sidecar — it re-enters the absorb gate
+    (shards are never checkpointed; live actors refill them), completes
+    the TOTAL 8-phase target, and every counter continues monotone."""
+    from r2d2dpg_tpu.fleet import load_fleet_counters
+    from r2d2dpg_tpu.fleet.actor import FleetActor
+    from r2d2dpg_tpu.utils import CheckpointManager
+
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    def sampler_run(n_total, resume):
+        trainer = PENDULUM_TINY.build()
+        learner = SamplerLearner(
+            trainer,
+            FleetConfig(num_actors=1, idle_timeout_s=120),
+            num_shards=2,
+        )
+        address = learner.start()
+        actor = FleetActor(
+            PENDULUM_TINY, actor_id=0, num_actors=1, address=address, seed=0
+        )
+
+        def loop():
+            try:
+                actor.run()  # stream until the server teardown
+            except Exception:  # noqa: BLE001
+                pass
+
+        thread = threading.Thread(target=loop, daemon=True)
+        thread.start()
+        ckpt = CheckpointManager(ckpt_dir, save_every=2, light=True)
+        resume_from = None
+        state = None
+        if resume:
+            import dataclasses as dc
+
+            step = ckpt.latest_step
+            state = trainer.init()
+            state = dc.replace(state, train=ckpt.restore(state))
+            resume_from = load_fleet_counters(ckpt_dir, step)
+        try:
+            state = learner.run(
+                n_total,
+                state=state,
+                log_every=0,
+                ckpt=ckpt,
+                checkpoint_every=2,
+                resume_from=resume_from,
+            )
+        finally:
+            learner.close()
+            ckpt.close()
+            thread.join(timeout=30)
+        return trainer, learner, state
+
+    t1, l1, s1 = sampler_run(4, resume=False)
+    assert l1.counters()["drained"] == 4
+    assert int(s1.train.step) == 4 * t1.config.learner_steps
+    saved = load_fleet_counters(ckpt_dir, 4)
+    assert saved["drained"] == 4 and saved["env_steps_total"] > 0
+
+    t2, l2, s2 = sampler_run(8, resume=True)
+    c2 = l2.counters()
+    assert c2["drained"] == 8
+    assert int(s2.train.step) == 8 * t2.config.learner_steps
+    assert c2["env_steps_total"] > saved["env_steps_total"]
+    assert c2["param_version"] > saved["param_version"]
+    assert l2.stats()["train_phases"] == 4  # this incarnation's share
+    assert l2.stats()["train_phases_total"] == 8
+
+
+# ----------------------------------------------------------------- refusals
+def test_sampler_learner_rejections():
+    trainer = PENDULUM_TINY.build()
+    with pytest.raises(ValueError, match="num_actors"):
+        SamplerLearner(trainer, FleetConfig(num_actors=0), num_shards=1)
+    with pytest.raises(ValueError, match="num_shards"):
+        SamplerLearner(trainer, FleetConfig(num_actors=1), num_shards=0)
+    with pytest.raises(ValueError, match="divisible"):
+        SamplerLearner(trainer, FleetConfig(num_actors=1), num_shards=3)
+    with pytest.raises(ValueError, match="drain"):
+        SamplerLearner(
+            trainer,
+            FleetConfig(num_actors=1, drain_coalesce=2),
+            num_shards=1,
+        )
+
+
+def test_train_cli_refuses_sampler_combos():
+    from r2d2dpg_tpu import train
+
+    # Shards without a fleet: nothing feeds them.
+    args = train.parse_args(
+        ["--config", "pendulum_tiny", "--replay-shards", "2"]
+    )
+    with pytest.raises(SystemExit, match="requires --actors"):
+        train.run(args)
+    # No central drain to coalesce; no device arena for the dp learner.
+    for flags in (
+        ["--drain-coalesce", "4"],
+        ["--learner-dp", "2"],
+    ):
+        args = train.parse_args(
+            [
+                "--config", "pendulum_tiny",
+                "--actors", "2",
+                "--replay-shards", "2",
+                *flags,
+            ]
+        )
+        with pytest.raises(SystemExit, match="does not compose"):
+            train.run(args)
+    # Sampler-class chaos drills on the central drain would stall the
+    # DRAIN thread (queue fills, actors shed) while recording evidence
+    # for an invariant that path cannot exhibit — refused loudly.
+    for spec in ("stall_sampler@p2:1s", "kill_sampler_conn@p2"):
+        args = train.parse_args(
+            [
+                "--config", "pendulum_tiny",
+                "--actors", "2",
+                "--chaos-spec", spec,
+            ]
+        )
+        with pytest.raises(SystemExit, match="replay-shards"):
+            train.run(args)
+
+
+# ------------------------------------------------------------ trace + obs
+def test_sampler_gauges_and_trace_hops_registered():
+    """The obs satellite: per-shard gauges are live set_fn closures (no
+    device fetch anywhere), and the two new trace hops are legal HOPS
+    with registered histograms."""
+    from r2d2dpg_tpu.obs import get_registry
+    from r2d2dpg_tpu.obs import trace as obs_trace
+
+    shards = ShardSet(2, 4, alpha=1.0)
+    shards.shards[1].add(
+        _np_staged().seq, np.array([2.0, 3.0], np.float64)
+    )
+    snap = get_registry().snapshot()
+    occ = {
+        s["labels"]["shard"]: s["value"]
+        for s in snap["r2d2dpg_replay_shard_occupancy"]["samples"]
+    }
+    psum = {
+        s["labels"]["shard"]: s["value"]
+        for s in snap["r2d2dpg_replay_shard_priority_sum"]["samples"]
+    }
+    assert occ["1"] == 2.0 and occ["0"] == 0.0
+    assert psum["1"] == 5.0
+    assert "sample_req" in obs_trace.HOPS and "batch_return" in obs_trace.HOPS
+    for hop in ("sample_req", "batch_return"):
+        obs_trace.record_hop(hop, 1.0, 2.0, trace_id=7)
+    with pytest.raises(ValueError, match="unknown trace hop"):
+        obs_trace.record_hop("shard_hop", 0.0, 1.0, trace_id=7)

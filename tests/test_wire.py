@@ -548,6 +548,236 @@ def test_negotiation_check():
     )
 
 
+# ------------------------------------- sampler frames (ISSUE 10 satellite)
+def _sampler_handles(n=3):
+    rng = np.random.default_rng(5)
+    return (
+        np.arange(n, dtype=np.int64),
+        np.arange(10, 10 + n, dtype=np.int64),
+        (rng.random(n) / n).astype(np.float64),
+    )
+
+
+def test_golden_sample_req_exact_bytes():
+    """SAMPLE_REQ: three int scalars in declared key order — the layout
+    is a contract (a cross-process shard must parse what today's
+    loopback packs), rebuilt independently from the documented format."""
+    payload = b"".join(
+        wire.pack_sample_req(
+            TreePacker(WireConfig()), req_id=7, shard=2, quota=16
+        )
+    )
+    schema = {"d": [["req_id", "i"], ["shard", "i"], ["quota", "i"]]}
+    sjson = json.dumps(schema, separators=(",", ":")).encode()
+    body = struct.pack("<q", 7) + struct.pack("<q", 2) + struct.pack("<q", 16)
+    want = (
+        _HDR.pack(1, 0, 1, 0, zlib.crc32(sjson), len(body))
+        + struct.pack("!I", len(sjson))
+        + sjson
+        + body
+    )
+    assert payload == want
+    req = wire.unpack_sample_req(TreeUnpacker().unpack(payload))
+    assert req == {"req_id": 7, "shard": 2, "quota": 16}
+
+
+def test_golden_prio_update_exact_bytes():
+    """PRIO: the write-back frame's byte layout — shard scalar, then
+    slots/gens (int64) and priorities (f32, PINNED on every lane)
+    depth-first in key order."""
+    slots, gens, _ = _sampler_handles()
+    prios = np.array([0.5, 2.0, 8.0], np.float32)
+    payload = b"".join(
+        wire.pack_prio_update(
+            TreePacker(WireConfig()), shard=1, slots=slots, gens=gens,
+            priorities=prios,
+        )
+    )
+    schema = {
+        "d": [
+            ["shard", "i"],
+            ["slots", {"a": ["int64", "int64", [3]]}],
+            ["gens", {"a": ["int64", "int64", [3]]}],
+            ["priorities", {"a": ["float32", "float32", [3]]}],
+        ]
+    }
+    sjson = json.dumps(schema, separators=(",", ":")).encode()
+    body = (
+        struct.pack("<q", 1)
+        + slots.tobytes()
+        + gens.tobytes()
+        + prios.tobytes()
+    )
+    want = (
+        _HDR.pack(1, 0, 1, 0, zlib.crc32(sjson), len(body))
+        + struct.pack("!I", len(sjson))
+        + sjson
+        + body
+    )
+    assert payload == want
+    upd = wire.unpack_prio_update(TreeUnpacker().unpack(payload))
+    np.testing.assert_array_equal(upd["priorities"], prios)
+
+
+@pytest.mark.parametrize("encoding", ["f32", "bf16"])
+def test_shard_batch_frame_roundtrip_and_pinned_leaves(encoding):
+    """BATCH: the training-ready answer roundtrips on both lanes — the
+    write-back handles (slots/gens) and probabilities are exact on EVERY
+    lane (int64/float64 are never downcast; quantizing the probs would
+    corrupt the IS weights), while bf16 quantizes only the sequence
+    observations, the same contract as SEQS frames."""
+    slots, gens, probs = _sampler_handles()
+    staged = _staged(b=3, priorities=False)
+    payload = b"".join(
+        wire.pack_shard_batch(
+            TreePacker(WireConfig(encoding=encoding)),
+            req_id=9,
+            shard=1,
+            staged=staged,
+            slots=slots,
+            gens=gens,
+            probs=probs,
+            priority_sum=12.5,
+            occupancy=3,
+        )
+    )
+    out = wire.unpack_shard_batch(TreeUnpacker().unpack(payload))
+    assert out["req_id"] == 9 and out["shard"] == 1
+    assert out["priority_sum"] == 12.5 and out["occupancy"] == 3
+    np.testing.assert_array_equal(out["slots"], slots)
+    np.testing.assert_array_equal(out["gens"], gens)
+    np.testing.assert_array_equal(out["probs"], probs)  # exact, both lanes
+    assert out["probs"].dtype == np.float64
+    if encoding == "f32":
+        np.testing.assert_array_equal(out["staged"].seq.obs, staged.seq.obs)
+    else:
+        np.testing.assert_allclose(
+            out["staged"].seq.obs, staged.seq.obs, rtol=2**-8
+        )
+        np.testing.assert_array_equal(  # pinned even on the bf16 lane
+            out["staged"].seq.reward, staged.seq.reward
+        )
+
+
+def test_sampler_frame_validation_refuses_malformed():
+    """The unpack validators refuse shape lies loudly (a quota of -1, a
+    handles/sequences length mismatch, wrong payload types) — corrupt
+    sampler control frames must kill the exchange, never mis-sample."""
+    slots, gens, probs = _sampler_handles()
+    with pytest.raises(WireFormatError, match="SAMPLE_REQ"):
+        wire.unpack_sample_req({"req_id": 1, "shard": 0})  # missing quota
+    with pytest.raises(WireFormatError, match="quota"):
+        wire.unpack_sample_req({"req_id": 1, "shard": 0, "quota": -1})
+    with pytest.raises(WireFormatError, match="malformed BATCH"):
+        wire.unpack_shard_batch({"req_id": 1})
+    with pytest.raises(WireFormatError, match="length mismatch"):
+        wire.unpack_shard_batch(
+            {
+                "req_id": 1,
+                "shard": 0,
+                "priority_sum": 1.0,
+                "occupancy": 3,
+                "staged": _staged(b=2, priorities=False),  # 2 != 3 handles
+                "slots": slots,
+                "gens": gens,
+                "probs": probs,
+            }
+        )
+    with pytest.raises(WireFormatError, match="malformed PRIO"):
+        wire.unpack_prio_update({"shard": 0, "slots": slots})
+    with pytest.raises(WireFormatError, match="length mismatch"):
+        wire.unpack_prio_update(
+            {
+                "shard": 0,
+                "slots": slots,
+                "gens": gens[:2],
+                "priorities": np.ones(3, np.float32),
+            }
+        )
+    # Range discipline: negative shard/slot handles must refuse at the
+    # codec (python negative indexing would silently alias ring slots).
+    with pytest.raises(WireFormatError, match=">= 0"):
+        wire.unpack_sample_req({"req_id": 1, "shard": -1, "quota": 2})
+    with pytest.raises(WireFormatError, match=">= 0"):
+        wire.unpack_prio_update(
+            {
+                "shard": 0,
+                "slots": np.array([-1, 0, 1], np.int64),
+                "gens": gens,
+                "priorities": np.ones(3, np.float32),
+            }
+        )
+    with pytest.raises(WireFormatError, match=">= 0"):
+        wire.unpack_shard_batch(
+            {
+                "req_id": 1,
+                "shard": 0,
+                "priority_sum": 1.0,
+                "occupancy": 3,
+                "staged": _staged(b=3, priorities=False),
+                "slots": np.array([0, -2, 1], np.int64),
+                "gens": gens,
+                "probs": probs,
+            }
+        )
+    # A frame omitting the advertisement fields is malformed outright
+    # (a remote learner's quota refresh reads them — wire.py docstring).
+    with pytest.raises(WireFormatError, match="malformed BATCH"):
+        wire.unpack_shard_batch(
+            {
+                "req_id": 1,
+                "shard": 0,
+                "staged": _staged(b=3, priorities=False),
+                "slots": slots,
+                "gens": gens,
+                "probs": probs,
+            }
+        )
+    # And the ring boundary refuses out-of-capacity write-back handles.
+    from r2d2dpg_tpu.replay.sharded import ReplayShard
+
+    shard = ReplayShard(4, alpha=1.0)
+    shard.add(_staged(b=3, priorities=False).seq, np.ones(3))
+    with pytest.raises(ValueError, match="outside shard capacity"):
+        shard.update_priorities(
+            np.array([7]), np.array([1]), np.array([2.0])
+        )
+
+
+def test_sampler_frames_inherit_zip_bomb_guard():
+    """The new frames are ordinary codec payloads, so the SEQS hardening
+    applies verbatim: a declared-decompressed-length lie is refused, and
+    a bomb declaring past the ceiling is refused BEFORE allocation."""
+    slots, gens, probs = _sampler_handles()
+    payload = bytearray(
+        b"".join(
+            wire.pack_shard_batch(
+                TreePacker(WireConfig(compress="zlib")),
+                req_id=1,
+                shard=0,
+                staged=_staged(b=3, priorities=False),
+                slots=slots,
+                gens=gens,
+                probs=probs,
+                priority_sum=1.0,
+                occupancy=3,
+            )
+        )
+    )
+    _, comp, flags, _, sid, raw_len = _HDR.unpack_from(payload, 0)
+    # Declared-length lie (both directions).
+    for lie in (raw_len - 8, raw_len + 8):
+        lying = bytearray(payload)
+        lying[:_HDR.size] = _HDR.pack(1, comp, flags, 0, sid, lie)
+        with pytest.raises((WireFormatError, FrameTooLarge)):
+            TreeUnpacker().unpack(bytes(lying))
+    # Oversize declaration: refused on the DECLARED size, pre-alloc.
+    huge = bytearray(payload)
+    huge[:_HDR.size] = _HDR.pack(1, comp, flags, 0, sid, 1 << 40)
+    with pytest.raises(FrameTooLarge, match="declared decompressed"):
+        TreeUnpacker(max_frame_bytes=1 << 20).unpack(bytes(huge))
+
+
 # ------------------------------------------------------- coalesce helpers
 def test_stack_staged_concatenates_along_batch():
     a, b = _staged(b=2), _staged(b=3)
