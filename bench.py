@@ -676,6 +676,10 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
             "1": _learner_dp_leg(1, phases),
             "2": _learner_dp_leg(2, phases),
         }
+        # Full-topology probe (ISSUE 11): actors x shards x dp composed
+        # through the CLI, with the lr/batch co-scaling note stamped —
+        # see _composed_leg's honesty docstring (single-core contention).
+        rec["fleet_composed"] = _composed_leg(phases)
         top_leg = rec["fleet"][str(actor_counts[-1])]
         top = top_leg["arena_add_seqs_per_sec"]
         rec["value"] = top
@@ -808,6 +812,94 @@ def _learner_dp_leg(dp: int, phases: int) -> dict:
     return leg
 
 
+def _composed_leg(phases: int = 12) -> dict:
+    """``python bench.py fleet_composed`` — the full-topology run
+    (ISSUE 11): ``--actors 2 --replay-shards 2 --learner-dp 2`` through
+    the real train.py CLI in a SUBPROCESS (the dp mesh needs
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=2`` before jax
+    initializes, same discipline as ``_learner_dp_leg``).  Fleet actors
+    feed 2 ingest-edge shards and the dp=2 sampler learner pulls
+    mesh-sharded batches — the first run where all three scaling axes
+    run together.
+
+    The leg also exercises the lr/batch co-scaling recipe the composed
+    sampling bandwidth exists for (PAPERS.md 1803.02811): batch doubled
+    to 128 with ``--lr-scale-batch 1``, and the resulting scale note is
+    stamped into the record.
+
+    HONESTY (carried over from fleet_learner_dp): on this container the
+    2 forced host devices time-slice a SINGLE CPU core with 2 actor
+    processes, so throughput here is a contention artifact, not a dp
+    speedup — the claim this leg records is *the composition runs end to
+    end with sheds=0 and monotone counters*; the speedup evidence path
+    is a real mesh (learner_dp_gate + topology_gate stamp any such
+    evidence dir)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("R2D2DPG_PALLAS_INTERPRET", "1")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+    cmd = [
+        sys.executable, "-m", "r2d2dpg_tpu.train",
+        "--config", "pendulum_r2d2", "--num-envs", "64",
+        "--actors", "2", "--replay-shards", "2", "--learner-dp", "2",
+        "--batch-size", "128", "--lr-scale-batch", "1",
+        "--fleet-publish-every", "4",
+        "--phases", str(phases), "--log-every", "0",
+    ]
+    try:
+        out = subprocess.run(
+            cmd, env=env, cwd=HERE, capture_output=True, text=True,
+            timeout=900,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "composed leg exceeded 900s"}
+    stats = {}
+    lr_note = topo_note = ""
+    for line in out.stdout.splitlines():
+        if line.startswith("lr-scale-batch: "):
+            lr_note = line[len("lr-scale-batch: "):]
+        if line.startswith("topology: "):
+            topo_note = line[len("topology: "):]
+        if not line.startswith("fleet: ") or "train_phases" not in line:
+            continue
+        toks = line[len("fleet: "):].split()
+        try:
+            stats = {
+                toks[i]: float(toks[i + 1])
+                for i in range(0, len(toks) - 1, 2)
+            }
+        except ValueError:
+            continue
+    if not stats:
+        return {"error": f"rc={out.returncode}: {out.stderr[-300:]}"}
+    leg = {
+        "topology": topo_note,
+        "lr_scale_batch": lr_note,  # the 1803.02811 co-scaling note
+        "learner_steps_per_sec": round(
+            stats.get("train_learner_steps_per_sec", 0.0), 2
+        ),
+        "trained_seqs_per_sec": round(
+            stats.get("trained_seqs", 0.0) / max(stats.get("wall_s", 0.0), 1e-9),
+            2,
+        ),
+        "trained_seqs": stats.get("trained_seqs", 0.0),
+        "bytes_per_trained_seq": round(
+            stats.get("bytes_per_trained_seq", 0.0), 1
+        ),
+        "sheds": stats.get("sheds", -1.0),
+        "replay_occupancy": stats.get("replay_occupancy", 0.0),
+        "overlap_fraction": round(stats.get("overlap_fraction", 0.0), 3),
+    }
+    if out.returncode != 0:
+        leg["error"] = f"rc={out.returncode}: {out.stderr[-300:]}"
+    return leg
+
+
 def worker() -> None:
     """Measurement body — runs in a child with the backend already pinned."""
     import jax
@@ -928,5 +1020,10 @@ if __name__ == "__main__":
         # Local CPU probe: never touches the TPU tunnel, so none of the
         # preempt/settle/re-arm choreography above applies.
         _fleet_probe()
+    elif len(sys.argv) > 1 and sys.argv[1] == "fleet_composed":
+        # Just the composed-topology leg (subprocess; CPU-local): prints
+        # ONE JSON object — merge it into BENCH_FLEET.json's
+        # "fleet_composed" key beside the single-axis legs.
+        print(json.dumps({"fleet_composed": _composed_leg()}))
     else:
         main()

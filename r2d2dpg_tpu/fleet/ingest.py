@@ -1474,6 +1474,11 @@ class FleetLearner:
                 "learner_wait_p50_ms": lw_p50 * 1e3,
                 "learner_wait_p99_ms": lw_p99 * 1e3,
                 "learner_wait_total_s": lw_total,
+                # The pipelined executor's overlap instrumentation on the
+                # fleet schedule (ISSUE 11): fraction of the wall during
+                # which the learner had staged data available — same
+                # definition as PipelineExecutor.stats (1 - wait / wall).
+                "overlap_fraction": max(0.0, 1.0 - lw_total / wall),
                 # Wire accounting (docs/FLEET.md "Wire format"): frame
                 # bytes as received vs the declared decompressed size.
                 "bytes_in_total": float(srv.seqs_bytes_total),

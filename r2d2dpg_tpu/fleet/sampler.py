@@ -56,6 +56,13 @@ sequences cross the sampling boundary into training
 ``Trainer.run`` through the CLI — ``scripts/lib_gate.sh sampler_gate``
 refuses to bless ``--replay-shards N`` evidence without that anchor plus
 the sampling-equivalence test.
+
+**Composes with ``--learner-dp`` since ISSUE 11** (docs/TOPOLOGY.md):
+with a ``DPLearnerTrainer``, the pulled ``[K, B]`` batch is placed
+through ``Trainer._put_staged(..., axis=1)`` so each dp slice receives
+its ``B/D`` rows at device_put time — the compiled K-update scan runs
+dp-sharded with no central reshard hop, and the learn program's outputs
+stay pinned to the replicated layout (stable donated avals).
 """
 
 from __future__ import annotations
@@ -229,12 +236,6 @@ class SamplerLearner:
                 "shard_map trainers fuse whole phases — use the base "
                 "Trainer"
             )
-        if getattr(trainer, "lstate_shardings", None) is not None:
-            raise ValueError(
-                "--replay-shards does not compose with --learner-dp: the "
-                "dp learner shards the DEVICE arena the sampler path "
-                "bypasses (docs/REPLAY.md 'Refused knobs')"
-            )
         if config.num_actors < 1:
             raise ValueError(
                 "SamplerLearner requires num_actors >= 1 (replay shards "
@@ -303,7 +304,19 @@ class SamplerLearner:
         self._batch_unpacker = wire.TreeUnpacker(
             max_frame_bytes=config.max_frame_bytes
         )
-        self._learn_prog = jax.jit(self._learn_impl, donate_argnums=(0,))
+        # dp-mesh composition (ISSUE 11, docs/TOPOLOGY.md): a
+        # DPLearnerTrainer replicates train and shards the pulled batch
+        # over dp via _put_staged(axis=1) below.  Pinning the outputs to
+        # the replicated layout keeps the donated chain's avals stable
+        # (the FleetLearner drain's out_shardings discipline); None for
+        # single-device trainers.
+        self._replicated = getattr(trainer, "_replicated", None)
+        learn_kwargs: Dict[str, Any] = {"donate_argnums": (0,)}
+        if self._replicated is not None:
+            learn_kwargs["out_shardings"] = (
+                self._replicated, self._replicated, self._replicated
+            )
+        self._learn_prog = jax.jit(self._learn_impl, **learn_kwargs)
         self._req_id = 0
         self.sample_bytes_total = 0  # SAMPLE_REQ + BATCH + PRIO, with headers
         self.trained_seqs_total = 0
@@ -599,20 +612,41 @@ class SamplerLearner:
                 )
                 t_batches = time.time()
                 self.sample_assemble.add(time.monotonic() - t_assemble)
-                # [n] -> [K, B] for the compiled K-update scan.
-                seqs = jax.tree_util.tree_map(
-                    lambda x: np.reshape(
-                        x, (cfg.learner_steps, cfg.batch_size) + x.shape[1:]
+                # [n] -> [K, B] for the compiled K-update scan, then
+                # mesh placement through the _put_staged hook on the
+                # BATCH axis (axis=1): under --learner-dp each dp slice
+                # receives its B/D rows here, at device_put time, so the
+                # learn program's _reshard_batch constraint is already
+                # satisfied — the BATCH frames from M shards land
+                # per-dp-slice with no central reshard hop (identity for
+                # single-device trainers; docs/TOPOLOGY.md).
+                seqs = t._put_staged(
+                    jax.tree_util.tree_map(
+                        lambda x: np.reshape(
+                            x,
+                            (cfg.learner_steps, cfg.batch_size)
+                            + x.shape[1:],
+                        ),
+                        seq_np,
                     ),
-                    seq_np,
+                    axis=1,
                 )
-                probs = np.reshape(
-                    probs_np.astype(np.float32),
-                    (cfg.learner_steps, cfg.batch_size),
+                probs = t._put_staged(
+                    np.reshape(
+                        probs_np.astype(np.float32),
+                        (cfg.learner_steps, cfg.batch_size),
+                    ),
+                    axis=1,
                 )
+                size = np.float32(occ)
+                if self._replicated is not None:
+                    # Scalars replicate explicitly so every learn input
+                    # shares the mesh's device set (uncommitted host
+                    # scalars would otherwise default single-device).
+                    size = jax.device_put(size, self._replicated)
                 rng, key = jax.random.split(rng)
                 train, prios_dev, last_metrics = self._learn_prog(
-                    train, seqs, probs, np.float32(occ), key
+                    train, seqs, probs, size, key
                 )
                 t_dispatch = time.time()
                 # ONE host fetch per phase: the write-back priorities
@@ -726,6 +760,12 @@ class SamplerLearner:
                 "sampler_wait_p50_ms": sw_p50 * 1e3,
                 "sampler_wait_p99_ms": sw_p99 * 1e3,
                 "sampler_wait_total_s": sw_total,
+                # The pipelined executor's overlap instrumentation,
+                # riding the composed loop (ISSUE 11): fraction of the
+                # wall during which the learner had sample data available
+                # (1.0 = collection fully hidden behind learning — same
+                # definition as PipelineExecutor.stats / FleetLearner).
+                "overlap_fraction": max(0.0, 1.0 - sw_total / wall),
             }
             if train_t0 is not None:
                 train_wall = max(t_end - train_t0, 1e-9)

@@ -203,16 +203,25 @@ class DPLearnerTrainer(Trainer):
         )
 
     # ---------------------------------------------------------- fleet hooks
-    def _put_staged(self, staged):
-        """Lay a host staged batch over the dp mesh (the hybrid trainer's
-        ``_put_fleet`` idiom): leading axis B over dp, global assembly via
+    def _put_staged(self, staged, axis: int = 0):
+        """Lay a host batch tree over the dp mesh (the hybrid trainer's
+        ``_put_fleet`` idiom): batch axis over dp, global assembly via
         ``jax.make_array_from_process_local_data`` when multi-process.  A
         width that does not divide the mesh (foreign actor shapes — a
         defensive case, ``structural_argv`` pins num_envs fleet-wide)
-        replicates instead: correctness over bandwidth."""
-        b = int(np.shape(staged.seq.reward)[0])
+        replicates instead: correctness over bandwidth.
+
+        ``axis=0`` is the staged fleet layout (leaves ``[B, ...]``);
+        ``axis=1`` is the sampler learner's pulled layout (leaves
+        ``[K, B, ...]``): each dp slice receives its ``B/D`` rows at
+        placement time, so the composed sampler+dp run's learn program
+        sees a batch already in the ``_reshard_batch`` layout — no
+        central reshard hop (docs/TOPOLOGY.md)."""
+        b = int(
+            np.shape(jax.tree_util.tree_leaves(staged)[0])[axis]
+        )
         # Divisibility is a GLOBAL property: each process contributes b
-        # local rows, and the assembled array's leading dim is b * nproc.
+        # local rows, and the assembled array's batch dim is b * nproc.
         sharded = (b * self._nproc) % self.num_devices == 0
         if not sharded and self._nproc > 1:
             # The defensive replicate fallback is single-process-only:
@@ -224,14 +233,21 @@ class DPLearnerTrainer(Trainer):
                 f"processes does not divide the {self.num_devices}-device "
                 f"mesh"
             )
+        if axis != 0 and self._nproc > 1:
+            # Only the staged axis-0 path is multi-process-shaped today
+            # (the sampler learner is single-process; its multi-HOST pull
+            # is a ROADMAP open item).
+            raise ValueError(
+                "batch-axis placement (axis != 0) is single-process only"
+            )
 
         def put(x):
             x = np.asarray(x)
             if not sharded:
                 return jax.device_put(x, self._replicated)
-            sh = NamedSharding(
-                self.mesh, P(*([DP_AXIS] + [None] * (x.ndim - 1)))
-            )
+            spec = [None] * x.ndim
+            spec[axis] = DP_AXIS
+            sh = NamedSharding(self.mesh, P(*spec))
             if self._nproc == 1:
                 return jax.device_put(x, sh)
             return jax.make_array_from_process_local_data(

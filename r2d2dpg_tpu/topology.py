@@ -1,0 +1,510 @@
+"""Composable topology: collect / ingest / sample / learn as ONE config.
+
+ISSUE 11 tentpole / ROADMAP "Compose the scaling axes".  Each scaling
+axis shipped as a fork that refused the others (``--actors`` vs
+``--replay-shards`` vs ``--learner-dp`` vs ``--pipeline``), policed by
+~10 scattered ``if`` branches in train.py.  Parallel Actors and Learners
+(PAPERS.md 2110.01101) frames scalable RL as a *composition of
+parallelism patterns*; this module is that composition point — the
+trainer decomposed into four stages with explicit contracts, a single
+resolved :class:`Topology`, ONE refusal table, and the assembly helpers
+train.py builds the run from.
+
+Stage contracts (docs/TOPOLOGY.md has the full matrix):
+
+**collect** — who steps environments and emits ``StagedSequences``.
+  ``local``: this process (in-graph pure-JAX scan, or the host env pool —
+  resolved at build time from the env, not a flag).  ``fleet``: N
+  supervised actor subprocesses streaming SEQS frames (``fleet/actor.py``).
+  Contract: produces staged batches of ``num_envs`` sequences with
+  optional local initial priorities plus banked accounting deltas.
+
+**ingest** — how collected experience reaches replay.
+  ``fused``: none — the phase-locked program collects straight into the
+  arena.  ``staging_queue``: the pipelined executor's bounded device-side
+  queue (``training/pipeline.py``).  ``central_drain``: the fleet ingest
+  server feeding one staging queue drained by ``FleetLearner``
+  (``fleet/ingest.py``).  ``sharded_rings``: per-shard prioritized host
+  rings written concurrently at the ingest edge (``replay/sharded.py``);
+  nothing sheds, full rings FIFO-evict.
+  Contract: delivers staged sequences into the sample stage's store while
+  keeping episode/step accounting monotone (shed/bank discipline).
+
+**sample** — where training batches come from.
+  ``arena``: the device ``ReplayArena``'s proportional sampler.
+  ``two_level``: shard quotas ∝ Σp^α then within-shard proportional
+  draws over SAMPLE_REQ/BATCH frames, distribution-equivalent to central
+  proportional sampling (``fleet/sampler.py``).
+  Contract: yields ``[K, B]`` batches plus per-draw probabilities for
+  importance weights, and accepts TD priority write-back.
+
+**learn** — who runs the K-update program, on what layout, on what clock.
+  Device layout: ``single_device`` | ``dp_mesh`` (params replicated,
+  batch dp-sharded, arena capacity-sharded — ``parallel/dp_learner.py``)
+  | ``spmd_mesh`` (whole phases under shard_map).  Schedule:
+  ``phase_locked`` (fused collect->learn), ``pipelined_overlap``
+  (collector/learner threads over the staging queue, overlap
+  instrumentation), ``drain_paced`` (fleet central drain: one staged
+  batch per phase), ``free_running`` (sampler pull loop: learner-paced,
+  the Ape-X relation).  The overlap instrumentation the pipelined
+  executor introduced (wait histograms -> ``overlap_fraction``) rides
+  every non-fused schedule.
+  Contract: consumes ``[K, B]`` batches in ONE compiled dispatch and
+  publishes versioned params back toward collect.
+
+The headline composition this module legalizes:
+``--actors N --replay-shards M --learner-dp D`` — fleet actors feed M
+ingest-edge shards and the sampler learner's pulled ``[K, B]`` batch
+lands MESH-SHARDED via ``Trainer._put_staged(..., axis=1)`` (each dp
+slice receives its B/D rows at placement time; no central reshard hop).
+
+Every newly-legal pairing keeps the gate discipline that made the single
+axes trustworthy: an off-settings determinism anchor
+(``--replay-shards 1 --learner-dp 1 --actors 0`` is bit-identical to
+``Trainer.run`` through the CLI — tests/test_topology.py,
+``scripts/lib_gate.sh topology_gate``), and every pairing that REMAINS
+unsupported is refused from the one :data:`REFUSALS` table below, each
+row pinned by a parametrized test so a silently-dropped refusal cannot
+regress.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+# --------------------------------------------------------------- topology
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """The resolved four-stage shape of one run (flag-derivable half).
+
+    ``collect="local"`` refines to in-graph vs host-pool at build time
+    from the env (``ExperimentConfig.build*``); everything else is fully
+    determined by the CLI flags.  ``describe()`` is the one-line stamp
+    evidence dirs and bench records carry (``topology.txt``)."""
+
+    collect: str  # "local" | "fleet"
+    ingest: str  # "fused" | "staging_queue" | "central_drain" | "sharded_rings"
+    sample: str  # "arena" | "two_level"
+    learn: str  # "single_device" | "dp_mesh" | "spmd_mesh"
+    schedule: str  # "phase_locked" | "pipelined_overlap" | "drain_paced" | "free_running"
+    actors: int = 0
+    replay_shards: int = 0
+    learner_dp: int = 0
+    spmd: int = 0
+    pipeline: bool = False
+
+    def describe(self) -> str:
+        return (
+            f"collect={self.collect} ingest={self.ingest} "
+            f"sample={self.sample} learn={self.learn} "
+            f"schedule={self.schedule} actors={self.actors} "
+            f"replay_shards={self.replay_shards} "
+            f"learner_dp={self.learner_dp} spmd={self.spmd}"
+        )
+
+    @property
+    def composed(self) -> bool:
+        """More than one scaling axis active (the topology_gate trigger)."""
+        axes = sum(
+            1
+            for v in (self.actors, self.replay_shards, self.learner_dp)
+            if v
+        )
+        return axes >= 2
+
+
+def resolve(args) -> Topology:
+    """Flags -> the four-stage topology (no validation; see validate)."""
+    fleet = bool(args.actors)
+    sharded = bool(fleet and args.replay_shards)
+    if sharded:
+        ingest, sample, schedule = "sharded_rings", "two_level", "free_running"
+    elif fleet:
+        ingest, sample, schedule = "central_drain", "arena", "drain_paced"
+    elif args.pipeline:
+        ingest, sample, schedule = "staging_queue", "arena", "pipelined_overlap"
+    else:
+        ingest, sample, schedule = "fused", "arena", "phase_locked"
+    if args.learner_dp:
+        learn = "dp_mesh"
+    elif args.spmd:
+        learn = "spmd_mesh"
+    else:
+        learn = "single_device"
+    return Topology(
+        collect="fleet" if fleet else "local",
+        ingest=ingest,
+        sample=sample,
+        learn=learn,
+        schedule=schedule,
+        actors=int(args.actors or 0),
+        replay_shards=int(args.replay_shards or 0),
+        learner_dp=int(args.learner_dp or 0),
+        spmd=int(args.spmd or 0),
+        pipeline=bool(args.pipeline),
+    )
+
+
+# ---------------------------------------------------------- refusal table
+
+
+@dataclasses.dataclass(frozen=True)
+class Refusal:
+    """One still-unsupported pairing: predicate, reason, evidence argv.
+
+    ``argv`` is a minimal flag set (appended to ``--config pendulum_tiny``)
+    that triggers exactly this row — the parametrized pin in
+    tests/test_topology.py runs each row's argv through ``train.run`` and
+    asserts the refusal fires with ``match`` in its message, so a row
+    silently dropped from this table fails a named test, not a user.
+    ``argv=None`` marks a row unreachable from a single-process test
+    environment (documented in ``reason``)."""
+
+    key: str
+    when: Callable[[object, int], bool]  # (args, process_count) -> refused?
+    reason: str  # the SystemExit message
+    match: str  # stable fragment the pinned test asserts on
+    argv: Optional[Tuple[str, ...]]
+
+
+def _fleet_only_knobs(a) -> bool:
+    return (
+        a.fleet_wire != "f32"
+        or a.fleet_compress != "none"
+        or a.drain_coalesce != 1
+        or a.chaos_spec is not None
+        or a.fleet_token is not None
+        or a.fleet_heartbeat is not None
+        or a.fleet_shed_after is not None
+    )
+
+
+def _chaos_sampler_faults(a) -> bool:
+    if not a.chaos_spec or a.replay_shards:
+        return False
+    from r2d2dpg_tpu.fleet.chaos import SAMPLER_FAULTS, parse_chaos_spec
+
+    return any(
+        f.kind in SAMPLER_FAULTS for f in parse_chaos_spec(a.chaos_spec)
+    )
+
+
+# ONE table.  Every pairing refused anywhere in the CLI lives here, with
+# its reason; train.py has no refusal branches of its own (value checks —
+# bounds, divisibility, grammar — stay in validate() below: they are not
+# pairings).  docs/TOPOLOGY.md renders this as the composition matrix.
+REFUSALS: Tuple[Refusal, ...] = (
+    # ------------------------------------------------- pipelined executor
+    Refusal(
+        key="pipeline-x-phase-subsystems",
+        when=lambda a, np: bool(
+            a.pipeline and (a.resume or a.eval_every or a.profile_phases)
+        ),
+        reason=(
+            "--pipeline 1 does not support --resume/--eval-every/"
+            "--profile-phases yet (the executor owns the phase loop; "
+            "docs/TOPOLOGY.md)"
+        ),
+        match="does not support",
+        argv=("--pipeline", "1", "--eval-every", "5"),
+    ),
+    Refusal(
+        key="pipeline-x-nan-inject",
+        when=lambda a, np: bool(a.pipeline and a.nan_inject_phase is not None),
+        reason=(
+            "--nan-inject-phase targets the phase-locked loop; use "
+            "--pipeline 0 for watchdog drills (docs/TOPOLOGY.md)"
+        ),
+        match="nan-inject",
+        argv=("--pipeline", "1", "--nan-inject-phase", "1"),
+    ),
+    # ------------------------------------------------------- fleet actors
+    Refusal(
+        key="actors-x-pipeline",
+        when=lambda a, np: bool(a.actors and a.pipeline),
+        reason=(
+            "--actors N does not compose with --pipeline 1: both executors "
+            "own the phase loop (docs/TOPOLOGY.md)"
+        ),
+        match="does not compose",
+        argv=("--actors", "2", "--pipeline", "1"),
+    ),
+    Refusal(
+        key="actors-x-spmd",
+        when=lambda a, np: bool(a.actors and a.spmd),
+        reason=(
+            "--actors N does not compose with --spmd: shard_map trainers "
+            "fuse whole phases, hiding the drain boundary the fleet "
+            "learner needs (use --learner-dp for a fleet-fed mesh; "
+            "docs/TOPOLOGY.md)"
+        ),
+        match="does not compose",
+        argv=("--actors", "2", "--spmd", "2"),
+    ),
+    Refusal(
+        key="actors-x-eval-every",
+        when=lambda a, np: bool(a.actors and a.eval_every),
+        reason=(
+            "--actors N does not compose with --eval-every: the fleet "
+            "learner owns the phase loop; run the final-checkpoint eval "
+            "instead (docs/TOPOLOGY.md)"
+        ),
+        match="does not compose",
+        argv=("--actors", "2", "--eval-every", "5"),
+    ),
+    Refusal(
+        key="actors-x-profile-phases",
+        when=lambda a, np: bool(a.actors and a.profile_phases),
+        reason=(
+            "--actors N does not compose with --profile-phases: the "
+            "profiler brackets the phase-locked loop this process never "
+            "runs under a fleet (docs/TOPOLOGY.md)"
+        ),
+        match="does not compose",
+        argv=("--actors", "2", "--profile-phases", "2"),
+    ),
+    Refusal(
+        key="actors-x-nan-inject",
+        when=lambda a, np: bool(a.actors and a.nan_inject_phase is not None),
+        reason=(
+            "--actors N does not compose with --nan-inject-phase: the "
+            "poison targets the in-process collect loop actors own "
+            "(docs/TOPOLOGY.md)"
+        ),
+        match="does not compose",
+        argv=("--actors", "2", "--nan-inject-phase", "1"),
+    ),
+    Refusal(
+        key="actors-x-overlap-learner",
+        when=lambda a, np: bool(a.actors and a.overlap_learner),
+        reason=(
+            "--actors N does not compose with --overlap-learner 1: the "
+            "interleaved updates hide under a host env pool this process "
+            "does not step under a fleet (docs/TOPOLOGY.md)"
+        ),
+        match="does not compose",
+        argv=("--actors", "2", "--overlap-learner", "1"),
+    ),
+    Refusal(
+        key="fleet-knobs-without-actors",
+        when=lambda a, np: bool(not a.actors and _fleet_only_knobs(a)),
+        reason=(
+            "--fleet-wire/--fleet-compress/--drain-coalesce/"
+            "--fleet-heartbeat/--fleet-token/--fleet-shed-after/"
+            "--chaos-spec require --actors N (the in-process schedules "
+            "have no fleet wire; docs/TOPOLOGY.md)"
+        ),
+        match="require --actors",
+        argv=("--fleet-wire", "bf16"),
+    ),
+    # ------------------------------------------------------ replay shards
+    Refusal(
+        key="shards-without-actors",
+        when=lambda a, np: bool(
+            not a.actors and a.replay_shards and a.replay_shards > 1
+        ),
+        reason=(
+            "--replay-shards N >= 2 requires --actors N (replay shards "
+            "are fed by actor SEQS traffic; --replay-shards 1 --actors 0 "
+            "routes the untouched phase-locked loop — the determinism "
+            "anchor; docs/TOPOLOGY.md)"
+        ),
+        match="requires --actors",
+        argv=("--replay-shards", "2"),
+    ),
+    Refusal(
+        key="shards-x-drain-coalesce",
+        when=lambda a, np: bool(a.replay_shards and a.drain_coalesce != 1),
+        reason=(
+            "--replay-shards does not compose with --drain-coalesce: "
+            "there is no central drain to coalesce on the sampler path "
+            "(docs/TOPOLOGY.md)"
+        ),
+        match="does not compose",
+        argv=("--actors", "2", "--replay-shards", "2",
+              "--drain-coalesce", "4"),
+    ),
+    # NB --replay-shards + --learner-dp COMPOSES since ISSUE 11 (the
+    # sampler's pulled [K, B] batch lands mesh-sharded via
+    # Trainer._put_staged(axis=1)); its anchor is
+    # tests/test_topology.py::test_sampler_dp_learn_anchor_bitwise.
+    # ------------------------------------------------------- dp learner
+    Refusal(
+        key="learner-dp-x-spmd",
+        when=lambda a, np: bool(a.learner_dp and a.spmd),
+        reason=(
+            "--learner-dp does not compose with --spmd: two mesh owners "
+            "(pjit-style dp learner vs shard_map whole-phase trainer; "
+            "docs/TOPOLOGY.md)"
+        ),
+        match="does not compose",
+        argv=("--learner-dp", "2", "--spmd", "2"),
+    ),
+    Refusal(
+        key="learner-dp-x-pipeline",
+        when=lambda a, np: bool(a.learner_dp and a.pipeline),
+        reason=(
+            "--learner-dp does not compose with --pipeline 1: the "
+            "pipelined executor's staging path is not mesh-placed "
+            "(docs/TOPOLOGY.md)"
+        ),
+        match="does not compose",
+        argv=("--learner-dp", "2", "--pipeline", "1"),
+    ),
+    Refusal(
+        key="learner-dp-x-overlap-learner",
+        when=lambda a, np: bool(a.learner_dp and a.overlap_learner),
+        reason=(
+            "--learner-dp does not compose with --overlap-learner 1: the "
+            "interleaved-update schedule belongs to the host-pool trainer "
+            "(docs/TOPOLOGY.md)"
+        ),
+        match="does not compose",
+        argv=("--learner-dp", "2", "--overlap-learner", "1"),
+    ),
+    # ------------------------------------------------------ chaos drills
+    Refusal(
+        key="sampler-chaos-without-shards",
+        when=lambda a, np: _chaos_sampler_faults(a),
+        reason=(
+            "--chaos-spec sampler-class faults (stall_sampler/"
+            "kill_sampler_conn) drill the in-network sampler peer class "
+            "and require --replay-shards N: on the central drain they "
+            "would stall the DRAIN thread while recording evidence for an "
+            "invariant that path cannot exhibit (docs/TOPOLOGY.md)"
+        ),
+        match="replay-shards",
+        argv=("--actors", "2", "--chaos-spec", "stall_sampler@p2:1s"),
+    ),
+    # -------------------------------------------------------- obs / trace
+    Refusal(
+        key="trace-without-staging-path",
+        when=lambda a, np: bool(
+            a.trace_sample and not (a.actors or a.pipeline)
+        ),
+        reason=(
+            "--trace-sample requires --actors N or --pipeline 1 (the "
+            "phase-locked fused schedule has no staging path to trace; "
+            "docs/TOPOLOGY.md)"
+        ),
+        match="requires --actors N or --pipeline",
+        argv=("--trace-sample", "0.5"),
+    ),
+    Refusal(
+        key="obs-fleet-without-fleet",
+        when=lambda a, np: bool(a.obs_fleet and not a.actors and np == 1),
+        reason=(
+            "--obs-fleet requires --actors N or a multi-process run (a "
+            "single process already scrapes itself on --obs-port; "
+            "docs/TOPOLOGY.md)"
+        ),
+        match="requires --actors",
+        argv=("--obs-fleet", "1"),
+    ),
+    Refusal(
+        key="obs-fleet-x-pipeline-multiprocess",
+        when=lambda a, np: bool(a.obs_fleet and a.pipeline and np > 1),
+        # Unreachable from a single-process pytest without mocking
+        # jax.process_count (tests/test_obs.py does exactly that, so the
+        # row stays pinned there); argv=None keeps the parametrized pin
+        # honest about what it can drive.
+        reason=(
+            "--obs-fleet with --pipeline 1 is not wired on multi-process "
+            "runs (the registry allgather rides the fused schedule's log "
+            "cadence) — drop --pipeline or --obs-fleet (docs/TOPOLOGY.md)"
+        ),
+        match="not wired on multi-process",
+        argv=None,
+    ),
+)
+
+
+# -------------------------------------------------------------- validation
+
+
+def validate(args, process_count: int = 1) -> Topology:
+    """Value checks + the refusal table -> the resolved Topology.
+
+    Raises SystemExit with the table row's documented reason on the
+    first refused pairing (one authority, no scattered argparse checks).
+    Config-dependent checks (capacity divisibility, min_replay
+    reachability) live with the code that owns the config — this function
+    sees flags only."""
+    # Value/grammar checks first (not pairings; the table's predicates may
+    # assume e.g. a parseable --chaos-spec).
+    if args.replay_shards and args.replay_shards < 1:
+        raise SystemExit("--replay-shards must be >= 1 (0 = off)")
+    if args.learner_dp and args.learner_dp < 1:
+        raise SystemExit("--learner-dp must be >= 1 (0 = off)")
+    if args.fleet_heartbeat is not None and args.fleet_heartbeat <= 0:
+        raise SystemExit("--fleet-heartbeat must be > 0 seconds")
+    if not 0.0 <= args.trace_sample <= 1.0:
+        raise SystemExit("--trace-sample must be in [0, 1]")
+    if args.chaos_spec:
+        # Malformed drill schedules refuse at startup, not after the
+        # fleet has spawned.
+        from r2d2dpg_tpu.fleet.chaos import parse_chaos_spec
+
+        try:
+            parse_chaos_spec(args.chaos_spec)
+        except ValueError as e:
+            raise SystemExit(f"--chaos-spec: {e}")
+    for rule in REFUSALS:
+        if rule.when(args, process_count):
+            raise SystemExit(rule.reason)
+    return resolve(args)
+
+
+# ---------------------------------------------------------------- assembly
+
+
+def build_trainer(topo: Topology, cfg, make_mesh=None):
+    """Assemble the learn-stage trainer the topology names.
+
+    ``make_mesh`` defaults to ``parallel.make_mesh`` (injectable for
+    tests).  Env-dependent refinements (host-pool vs in-graph collect,
+    and their build-time refusals) stay inside ``ExperimentConfig`` —
+    they need the constructed env, which flags cannot see."""
+    if topo.learn == "spmd_mesh" or topo.learn == "dp_mesh":
+        if make_mesh is None:
+            from r2d2dpg_tpu.parallel import make_mesh
+    if topo.learn == "spmd_mesh":
+        return cfg.build_spmd(make_mesh(topo.spmd))
+    if topo.learn == "dp_mesh":
+        try:
+            return cfg.build_dp_learner(
+                make_mesh(topo.learner_dp),
+                collect_local=topo.collect == "local",
+            )
+        except ValueError as e:
+            # Mesh wider than the devices, indivisible capacity/batch, or
+            # a host-pool config under --actors 0: refuse at startup.
+            raise SystemExit(f"--learner-dp: {e}")
+    return cfg.build()
+
+
+def build_fleet_learner(topo: Topology, trainer, fleet_config,
+                        replay_capacity=None):
+    """Assemble the ingest+sample+learn composition for a fleet run:
+    ``sharded_rings``/``two_level`` -> ``SamplerLearner`` (pull loop),
+    ``central_drain``/``arena`` -> ``FleetLearner`` (drain loop).  Both
+    compose with a dp-mesh trainer (the staged/pulled batches are placed
+    through ``Trainer._put_staged``)."""
+    if topo.sample == "two_level":
+        from r2d2dpg_tpu.fleet.sampler import SamplerLearner
+
+        try:
+            return SamplerLearner(
+                trainer,
+                fleet_config,
+                num_shards=topo.replay_shards,
+                total_capacity=replay_capacity,
+            )
+        except ValueError as e:
+            raise SystemExit(f"--replay-shards: {e}")
+    from r2d2dpg_tpu.fleet.ingest import FleetLearner
+
+    return FleetLearner(trainer, fleet_config)

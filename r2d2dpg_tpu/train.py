@@ -20,6 +20,7 @@ import os
 import time
 from typing import Optional
 
+from r2d2dpg_tpu import topology
 from r2d2dpg_tpu.configs import CONFIGS, ExperimentConfig, get_config
 
 
@@ -38,6 +39,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     # Orchestration scale overrides (SURVEY §2.5 hyperparameter flags).
     p.add_argument("--num-envs", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument(
+        "--lr-scale-batch", type=int, default=0, choices=[0, 1],
+        help="scale actor/critic learning rates linearly with the batch "
+        "size (Accelerated Methods, PAPERS.md 1803.02811): the resolved "
+        "lrs are multiplied by batch_size / <config default batch> — the "
+        "large-batch recipe the composed topology's sampling bandwidth "
+        "(--actors x --replay-shards x --learner-dp) makes reachable.  "
+        "Applied to the RESOLVED lrs (after --actor-lr/--critic-lr "
+        "overrides); a no-op scale of 1.0 is printed, never silent"
+    )
     p.add_argument("--learner-steps", type=int, default=None)
     p.add_argument("--min-replay", type=int, default=None)
     p.add_argument(
@@ -324,163 +335,50 @@ def run(args) -> dict:
     if args.nan_debug:
         nan_debug(True)
 
-    if args.pipeline and (args.resume or args.eval_every or args.profile_phases):
-        # The pipelined executor owns the phase loop; the per-phase
-        # subsystems of the phase-locked loop below don't compose with it
-        # yet — refuse rather than silently skip (docs/PIPELINE.md).
-        raise SystemExit(
-            "--pipeline 1 does not support --resume/--eval-every/"
-            "--profile-phases yet"
-        )
-    if args.pipeline and args.nan_inject_phase is not None:
-        raise SystemExit(
-            "--nan-inject-phase targets the phase-locked loop; "
-            "use --pipeline 0 for watchdog drills"
-        )
-    if args.actors:
-        # The fleet learner owns the phase loop (actors own collection);
-        # knobs that assume THIS process collects, or that another
-        # executor owns the loop, are refused loudly rather than silently
-        # ignored (docs/FLEET.md "Mutually exclusive knobs").  --resume
-        # and periodic checkpoints are SUPPORTED since ISSUE 7 (the
-        # learner-recovery contract; docs/FLEET.md "Failure modes").
-        for flag, bad in (
-            ("--pipeline 1", args.pipeline),
-            ("--spmd", args.spmd),
-            ("--eval-every", args.eval_every),
-            ("--profile-phases", args.profile_phases),
-            ("--nan-inject-phase", args.nan_inject_phase is not None),
-            ("--overlap-learner 1", args.overlap_learner),
-        ):
-            if bad:
-                raise SystemExit(
-                    f"--actors N does not compose with {flag}; run them "
-                    f"separately (docs/FLEET.md)"
-                )
-    elif (
-        args.fleet_wire != "f32"
-        or args.fleet_compress != "none"
-        or args.drain_coalesce != 1
-        or args.chaos_spec is not None
-        or args.fleet_token is not None
-        or args.fleet_heartbeat is not None
-        or args.fleet_shed_after is not None
-    ):
-        # The wire/drain fast lane, heartbeat, auth and chaos knobs are
-        # properties of the fleet data path; the in-process schedules have
-        # no wire to shape — refuse rather than silently ignore
-        # (docs/FLEET.md "Mutually exclusive knobs").
-        raise SystemExit(
-            "--fleet-wire/--fleet-compress/--drain-coalesce/"
-            "--fleet-heartbeat/--fleet-token/--fleet-shed-after/"
-            "--chaos-spec require "
-            "--actors N (the in-process schedules have no fleet wire)"
-        )
-    if args.replay_shards:
-        if args.replay_shards < 1:
-            raise SystemExit("--replay-shards must be >= 1 (0 = off)")
-        if not args.actors and args.replay_shards > 1:
-            # Replay shards are fed by actor SEQS traffic; without a
-            # fleet there is nothing to shard.  --replay-shards 1 alone
-            # is accepted and routes the untouched phase-locked loop —
-            # the determinism anchor sampler_gate enforces
-            # (docs/REPLAY.md "Determinism anchor").
-            raise SystemExit(
-                "--replay-shards N >= 2 requires --actors N (replay "
-                "shards are fed by actor traffic; docs/REPLAY.md)"
-            )
-        if args.drain_coalesce != 1:
-            raise SystemExit(
-                "--replay-shards does not compose with --drain-coalesce "
-                "(there is no central drain to coalesce; docs/REPLAY.md "
-                "'Refused knobs')"
-            )
-        if args.learner_dp:
-            raise SystemExit(
-                "--replay-shards does not compose with --learner-dp (the "
-                "dp learner shards the DEVICE arena the sampler path "
-                "bypasses; docs/REPLAY.md 'Refused knobs')"
-            )
-        if args.actors and args.replay_shards > args.actors:
-            # Integer actor ids route round-robin, so only
-            # min(actors, shards) shards ever get a feed: the surplus
-            # shards stay empty forever and effective replay capacity
-            # silently shrinks to that fraction — never silently.
-            print(
-                f"replay-shards: WARNING — {args.replay_shards} shards "
-                f"but only {args.actors} actors: "
-                f"{args.replay_shards - args.actors} shards will never "
-                f"receive traffic and effective replay capacity is "
-                f"{args.actors}/{args.replay_shards} of the configured "
-                f"capacity (docs/REPLAY.md 'Topology')",
-                flush=True,
-            )
-    if args.learner_dp:
-        if args.learner_dp < 1:
-            raise SystemExit("--learner-dp must be >= 1 (0 = off)")
-        # The dp learner owns the mesh and the drain/learn layout; knobs
-        # that put ANOTHER owner on the mesh or the phase loop are refused
-        # loudly rather than silently ignored (docs/FLEET.md "Multi-chip
-        # learner" has the matrix).  --actors N composes — that is the
-        # point — and --actors 0 runs the phase-locked loop on the mesh.
-        for flag, bad in (
-            ("--spmd", args.spmd),
-            ("--pipeline 1", args.pipeline),
-            ("--overlap-learner 1", args.overlap_learner),
-        ):
-            if bad:
-                raise SystemExit(
-                    f"--learner-dp does not compose with {flag}; run them "
-                    f"separately (docs/FLEET.md 'Multi-chip learner')"
-                )
-    if args.chaos_spec:
-        # Validate the grammar up front: a malformed drill schedule must
-        # refuse at startup, not after the fleet has spawned.
-        from r2d2dpg_tpu.fleet.chaos import SAMPLER_FAULTS, parse_chaos_spec
-
-        try:
-            faults = parse_chaos_spec(args.chaos_spec)
-        except ValueError as e:
-            raise SystemExit(f"--chaos-spec: {e}")
-        bad = sorted({f.kind for f in faults if f.kind in SAMPLER_FAULTS})
-        if bad and not args.replay_shards:
-            # A sampler-class drill on the central drain would stall the
-            # DRAIN thread (queue fills, actors shed) while recording
-            # evidence for an invariant — "shards ring-evict, nothing
-            # sheds" — that path cannot exhibit: refuse the mislabeled
-            # drill like every other incoherent knob combo.
-            raise SystemExit(
-                f"--chaos-spec faults {bad} drill the in-network sampler "
-                f"peer class and require --replay-shards N "
-                f"(docs/REPLAY.md 'Recovery contract')"
-            )
-    if args.fleet_heartbeat is not None and args.fleet_heartbeat <= 0:
-        raise SystemExit("--fleet-heartbeat must be > 0 seconds")
-    if not 0.0 <= args.trace_sample <= 1.0:
-        raise SystemExit("--trace-sample must be in [0, 1]")
-    if args.trace_sample and not (args.actors or args.pipeline):
-        # The trace names staging-path hops; the phase-locked fused
-        # schedule has none — refuse rather than silently record nothing.
-        raise SystemExit(
-            "--trace-sample requires --actors N or --pipeline 1 (the "
-            "phase-locked fused schedule has no staging path to trace)"
-        )
-    if args.obs_fleet and not args.actors and jax.process_count() == 1:
-        raise SystemExit(
-            "--obs-fleet requires --actors N or a multi-process run "
-            "(a single process already scrapes itself on --obs-port)"
-        )
-    if args.obs_fleet and args.pipeline and jax.process_count() > 1:
-        # The COLLECTIVE allgather leg rides the fused schedule's log
-        # cadence only; the pipelined loop has no wired call site —
-        # refuse rather than silently export nothing for rank > 0.
-        raise SystemExit(
-            "--obs-fleet with --pipeline 1 is not wired on multi-process "
-            "runs (the registry allgather rides the fused schedule's log "
-            "cadence) — drop --pipeline or --obs-fleet"
+    # ONE validation authority (ISSUE 11): every still-refused knob
+    # pairing lives in topology.REFUSALS with its documented reason —
+    # there are no ad-hoc refusal branches here.  The resolved Topology
+    # names the four stages (collect/ingest/sample/learn) this run
+    # assembles below (docs/TOPOLOGY.md has the composition matrix).
+    topo = topology.validate(args, process_count=jax.process_count())
+    if args.actors and args.replay_shards > args.actors:
+        # Integer actor ids route round-robin, so only
+        # min(actors, shards) shards ever get a feed: the surplus
+        # shards stay empty forever and effective replay capacity
+        # silently shrinks to that fraction — never silently.
+        print(
+            f"replay-shards: WARNING — {args.replay_shards} shards "
+            f"but only {args.actors} actors: "
+            f"{args.replay_shards - args.actors} shards will never "
+            f"receive traffic and effective replay capacity is "
+            f"{args.actors}/{args.replay_shards} of the configured "
+            f"capacity (docs/REPLAY.md 'Topology')",
+            flush=True,
         )
 
     cfg = _apply_overrides(get_config(args.config), args)
+    if args.lr_scale_batch:
+        # Linear lr/batch co-scaling (PAPERS.md 1803.02811): lr follows
+        # batch relative to the config's recorded recipe.  Applied to the
+        # RESOLVED values so explicit --actor-lr/--critic-lr overrides
+        # scale too; a scale of 1.0 is printed, never silent.
+        base_batch = get_config(args.config).trainer.batch_size
+        scale = cfg.trainer.batch_size / base_batch
+        cfg = dataclasses.replace(
+            cfg,
+            agent=dataclasses.replace(
+                cfg.agent,
+                actor_lr=cfg.agent.actor_lr * scale,
+                critic_lr=cfg.agent.critic_lr * scale,
+            ),
+        )
+        print(
+            f"lr-scale-batch: linear rule (1803.02811) batch "
+            f"{base_batch} -> {cfg.trainer.batch_size}, scale {scale:g} "
+            f"(actor_lr {cfg.agent.actor_lr:g}, critic_lr "
+            f"{cfg.agent.critic_lr:g})",
+            flush=True,
+        )
 
     if args.replay_shards and not args.actors:
         print(
@@ -523,33 +421,20 @@ def run(args) -> dict:
             ),
         )
 
-    if args.spmd:
-        from r2d2dpg_tpu.parallel import make_mesh
-
-        trainer = cfg.build_spmd(make_mesh(args.spmd))
-    elif args.learner_dp:
-        from r2d2dpg_tpu.parallel import make_mesh
-
-        try:
-            trainer = cfg.build_dp_learner(
-                make_mesh(args.learner_dp), collect_local=not args.actors
-            )
-        except ValueError as e:
-            # Mesh wider than the devices, indivisible capacity/batch, or
-            # a host-pool config under --actors 0: refuse at startup.
-            raise SystemExit(f"--learner-dp: {e}")
-    else:
-        trainer = cfg.build()
+    trainer = topology.build_trainer(topo, cfg)
 
     # Stamp the resolved backend where automation can gate on it: a TPU
     # campaign step that silently fell back to CPU must not be mistaken
     # for an on-chip result (round-3 campaign gates .done markers on this).
     backend = jax.default_backend()
     print(f"backend: {backend}", flush=True)
+    print(f"topology: {topo.describe()}", flush=True)
     if args.logdir:
         os.makedirs(args.logdir, exist_ok=True)
         with open(os.path.join(args.logdir, "backend.txt"), "w") as f:
             f.write(backend + "\n")
+        with open(os.path.join(args.logdir, "topology.txt"), "w") as f:
+            f.write(topo.describe() + "\n")
 
     # ------------------------------------------------------------ telemetry
     # Flight recorder is ALWAYS armed (an in-memory ring is ~free; the dump
@@ -649,7 +534,7 @@ def run(args) -> dict:
     if args.actors:
         return _run_fleet(
             trainer, cfg, state, logger, ckpt, args, watchdog, flight,
-            flight_path, replay_capacity=replay_capacity,
+            flight_path, replay_capacity=replay_capacity, topo=topo,
         )
 
     warm = trainer.window_fill_phases
@@ -914,7 +799,7 @@ def _run_pipelined(
 
 def _run_fleet(
     trainer, cfg, state, logger, ckpt, args, watchdog, flight, flight_path,
-    replay_capacity=None,
+    replay_capacity=None, topo=None,
 ) -> dict:
     """Drive the run through the actor fleet (--actors N, docs/FLEET.md).
 
@@ -926,7 +811,6 @@ def _run_fleet(
     from r2d2dpg_tpu.fleet import (
         ActorSupervisor,
         FleetConfig,
-        FleetLearner,
         WireConfig,
         default_actor_argv,
     )
@@ -995,25 +879,19 @@ def _run_fleet(
         heartbeat_s=heartbeat_s,
         auth_token=fleet_token,
     )
-    if args.replay_shards:
-        # In-network sampling (docs/REPLAY.md): replay shards at the
-        # ingest edge, learner pulls batches.  The shards own the
-        # experiment's REAL replay capacity — captured by run() BEFORE
-        # it shrank the trainer's unused device arena (one config
-        # resolution, no chance to desynchronize).
-        from r2d2dpg_tpu.fleet.sampler import SamplerLearner
-
-        try:
-            learner = SamplerLearner(
-                trainer,
-                fleet_config,
-                num_shards=args.replay_shards,
-                total_capacity=replay_capacity,
-            )
-        except ValueError as e:
-            raise SystemExit(f"--replay-shards: {e}")
-    else:
-        learner = FleetLearner(trainer, fleet_config)
+    # The ingest+sample+learn assembly comes from the validated Topology
+    # (docs/TOPOLOGY.md): sharded rings + two-level sampling ->
+    # SamplerLearner (composes with a dp-mesh trainer since ISSUE 11 —
+    # the pulled [K, B] batch lands mesh-sharded via _put_staged);
+    # central drain -> FleetLearner.  In sampler mode the shards own the
+    # experiment's REAL replay capacity — captured by run() BEFORE it
+    # shrank the trainer's unused device arena (one config resolution,
+    # no chance to desynchronize).
+    if topo is None:
+        topo = topology.resolve(args)
+    learner = topology.build_fleet_learner(
+        topo, trainer, fleet_config, replay_capacity=replay_capacity
+    )
     address = learner.start()
     print(
         f"fleet: ingest on {address}; spawning {args.actors} actors"

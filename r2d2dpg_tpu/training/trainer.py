@@ -185,12 +185,19 @@ class Trainer:
         """Hook: relayout a sampled batch before the learner step."""
         return batch
 
-    def _put_staged(self, staged):
-        """Hook: place a host-side staged batch (numpy leaves) for the
-        drain program.  Identity here — jit's implicit device_put; the
+    def _put_staged(self, staged, axis: int = 0):
+        """Hook: place a host-side batch tree (numpy leaves) for a
+        compiled program.  Identity here — jit's implicit device_put; the
         dp learner lays the batch out over its mesh instead
         (parallel/dp_learner.py, the hybrid trainer's ``_put_fleet``
-        idiom), so fleet payloads enter the sharded drain pre-placed."""
+        idiom), so fleet payloads enter the sharded drain pre-placed.
+
+        ``axis`` names the batch dimension the dp mesh shards: 0 for
+        staged fleet sequences (leaves ``[B, ...]``), 1 for the sampler
+        learner's pulled batches (leaves ``[K, B, ...]`` — each dp slice
+        receives its ``B/D`` rows at placement time, so the composed
+        ``--actors x --replay-shards x --learner-dp`` run has no central
+        reshard hop; docs/TOPOLOGY.md)."""
         return staged
 
     def _log_extra_refs(self, arena_state) -> list:

@@ -148,6 +148,10 @@ run_evidence() {
         echo "$dir: sampler equivalence gate FAILED (attempt $attempt)"
         continue
       fi
+      if ! topology_gate "$dir" "$@"; then
+        echo "$dir: composed-topology gate FAILED (attempt $attempt)"
+        continue
+      fi
       timeout --kill-after=30 --signal=TERM 1800 \
         env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
         python -m r2d2dpg_tpu.eval $evalflags \
@@ -362,6 +366,68 @@ sampler_gate() {
          -k 'determinism or equivalence' \
        > "$dir/sampler_gate.log" 2>&1; then
     touch "$dir/.sampler_equivalence_ok"
+    return 0
+  fi
+  return 1
+}
+
+# Composed-topology gate (ISSUE 11): a run dir trained with MORE THAN
+# ONE scaling axis (--actors N plus --replay-shards N and/or
+# --learner-dp N) may only be blessed (.done) if the per-pairing anchors
+# pass on this checkout — the composed off-settings determinism anchor
+# (--replay-shards 1 --learner-dp 1 --actors 0 bit-identical to
+# Trainer.run through the CLI) and the sampler+dp bitwise learn anchor
+# (tests/test_topology.py; docs/TOPOLOGY.md "Determinism anchors").  The
+# resolved axis triple is stamped into the evidence dir (topology.txt,
+# beside fleet_wire.txt/learner_dp.txt) for ANY multi-axis run, so a
+# blessed number always says which composition produced it.  Single-axis
+# runs pass through untouched — their own gates (fleet_gate,
+# learner_dp_gate, sampler_gate) already cover them.
+#   topology_gate <dir> <train args...>
+topology_gate() {
+  local dir=$1
+  shift
+  local _tg_actors=0 _tg_shards=0 _tg_dp=0 _tg_prev=""
+  local _tg_arg
+  for _tg_arg in "$@"; do
+    # Both argparse spellings: "--flag value" and "--flag=value".
+    case "$_tg_arg" in
+      --actors=*) _tg_actors=${_tg_arg#*=} ;;
+      --replay-shards=*) _tg_shards=${_tg_arg#*=} ;;
+      --learner-dp=*) _tg_dp=${_tg_arg#*=} ;;
+    esac
+    case "$_tg_prev" in
+      --actors) _tg_actors=$_tg_arg ;;
+      --replay-shards) _tg_shards=$_tg_arg ;;
+      --learner-dp) _tg_dp=$_tg_arg ;;
+    esac
+    _tg_prev=$_tg_arg
+  done
+  local _tg_axes=0
+  [ "${_tg_actors:-0}" != 0 ] && _tg_axes=$((_tg_axes + 1))
+  [ "${_tg_shards:-0}" != 0 ] && _tg_axes=$((_tg_axes + 1))
+  [ "${_tg_dp:-0}" != 0 ] && _tg_axes=$((_tg_axes + 1))
+  if [ "$_tg_axes" -lt 2 ]; then
+    return 0  # single-axis run: its own gate covers it
+  fi
+  # train.py already stamps the richer four-stage describe() line into
+  # <logdir>/topology.txt (it contains the actors=/replay_shards=/
+  # learner_dp= triple); only write the fallback triple when the run
+  # predates that stamp or used a different logdir.
+  if ! [ -f "$dir/topology.txt" ]; then
+    printf 'actors=%s replay_shards=%s learner_dp=%s\n' \
+      "$_tg_actors" "$_tg_shards" "$_tg_dp" > "$dir/topology.txt"
+  fi
+  if [ -f "$dir/.topology_anchors_ok" ]; then
+    return 0
+  fi
+  if timeout --kill-after=30 900 \
+       env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+       XLA_FLAGS= \
+       python -m pytest tests/test_topology.py -q -p no:cacheprovider \
+         -k 'determinism or anchor' \
+       > "$dir/topology_gate.log" 2>&1; then
+    touch "$dir/.topology_anchors_ok"
     return 0
   fi
   return 1

@@ -428,21 +428,19 @@ def test_train_cli_refuses_sampler_combos():
     )
     with pytest.raises(SystemExit, match="requires --actors"):
         train.run(args)
-    # No central drain to coalesce; no device arena for the dp learner.
-    for flags in (
-        ["--drain-coalesce", "4"],
-        ["--learner-dp", "2"],
-    ):
-        args = train.parse_args(
-            [
-                "--config", "pendulum_tiny",
-                "--actors", "2",
-                "--replay-shards", "2",
-                *flags,
-            ]
-        )
-        with pytest.raises(SystemExit, match="does not compose"):
-            train.run(args)
+    # No central drain to coalesce.  NB --learner-dp is NOT in this list
+    # since ISSUE 11: sampler+dp composes (the pulled batch lands
+    # mesh-sharded via _put_staged(axis=1) — tests/test_topology.py).
+    args = train.parse_args(
+        [
+            "--config", "pendulum_tiny",
+            "--actors", "2",
+            "--replay-shards", "2",
+            "--drain-coalesce", "4",
+        ]
+    )
+    with pytest.raises(SystemExit, match="does not compose"):
+        train.run(args)
     # Sampler-class chaos drills on the central drain would stall the
     # DRAIN thread (queue fills, actors shed) while recording evidence
     # for an invariant that path cannot exhibit — refused loudly.

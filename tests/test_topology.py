@@ -1,0 +1,307 @@
+"""Composable topology (ISSUE 11): the one refusal table, the composed
+determinism anchors, and the full-composition e2e
+(``r2d2dpg_tpu/topology.py``; docs/TOPOLOGY.md).
+
+Anchors ``scripts/lib_gate.sh topology_gate`` enforces before blessing a
+composed-topology (more than one scaling axis) evidence dir:
+
+- **composed off-settings determinism** — ``--replay-shards 1
+  --learner-dp 1 --actors 0`` routes the untouched phase-locked loop,
+  pinned BIT-identical to ``Trainer.run`` through the train.py CLI.
+- **sampler+dp learn anchor** — the sampler learn program through a
+  dp=1 mesh trainer (batch placed via ``_put_staged(axis=1)``, outputs
+  pinned replicated) is BITWISE the base trainer's on identical pulled
+  batches — the mesh layout is layout, never semantics.
+
+Plus the refusal-table pins: every still-refused pairing in
+``topology.REFUSALS`` is driven through ``train.run`` by its own
+parametrized case, so a silently-dropped refusal fails a named test.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu import topology
+from r2d2dpg_tpu.configs import PENDULUM_TINY
+from r2d2dpg_tpu.fleet import FleetConfig, SamplerLearner
+from r2d2dpg_tpu.parallel import make_mesh
+
+pytestmark = pytest.mark.topology
+
+N_TRAIN = 6
+LOG_EVERY = 2
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return [
+        i
+        for i, (x, y) in enumerate(zip(la, lb))
+        if not np.array_equal(np.asarray(x), np.asarray(y))
+    ]
+
+
+# ------------------------------------------------------ refusal-table pins
+@pytest.mark.parametrize(
+    "rule", topology.REFUSALS, ids=[r.key for r in topology.REFUSALS]
+)
+def test_refusal_table_pins_every_pairing(rule):
+    """Each table row's example argv must refuse through the REAL CLI
+    path with the row's documented reason — the regression pin the ISSUE
+    11 consolidation demands (a refusal deleted from the table, or a
+    predicate that stops firing, fails here by name)."""
+    from r2d2dpg_tpu import train
+
+    if rule.argv is None:
+        pytest.skip(
+            "unreachable from a single-process test env (pinned via "
+            "mocks in tests/test_obs.py)"
+        )
+    args = train.parse_args(["--config", "pendulum_tiny", *rule.argv])
+    with pytest.raises(SystemExit, match=rule.match):
+        train.run(args)
+
+
+def test_refusals_fire_from_validate_not_scattered_checks():
+    """The table IS the authority: topology.validate alone raises the
+    same refusals train.run surfaces (no train.py-resident branches)."""
+    from r2d2dpg_tpu import train
+
+    for rule in topology.REFUSALS:
+        if rule.argv is None:
+            continue
+        args = train.parse_args(["--config", "pendulum_tiny", *rule.argv])
+        with pytest.raises(SystemExit, match=rule.match):
+            topology.validate(args, process_count=1)
+
+
+def test_resolve_names_the_four_stages():
+    from r2d2dpg_tpu import train
+
+    cases = [
+        ([], ("local", "fused", "arena", "single_device", "phase_locked")),
+        (["--pipeline", "1"],
+         ("local", "staging_queue", "arena", "single_device",
+          "pipelined_overlap")),
+        (["--actors", "2"],
+         ("fleet", "central_drain", "arena", "single_device",
+          "drain_paced")),
+        (["--actors", "2", "--replay-shards", "2", "--learner-dp", "2"],
+         ("fleet", "sharded_rings", "two_level", "dp_mesh",
+          "free_running")),
+        (["--learner-dp", "2"],
+         ("local", "fused", "arena", "dp_mesh", "phase_locked")),
+    ]
+    for argv, want in cases:
+        t = topology.resolve(
+            train.parse_args(["--config", "pendulum_tiny", *argv])
+        )
+        got = (t.collect, t.ingest, t.sample, t.learn, t.schedule)
+        assert got == want, (argv, got)
+    assert topology.resolve(
+        train.parse_args(
+            ["--config", "pendulum_tiny", "--actors", "2",
+             "--replay-shards", "2"]
+        )
+    ).composed
+
+
+# ------------------------------------------------- composed off-settings
+def test_composed_off_settings_determinism_bit_identical(tmp_path):
+    """--replay-shards 1 --learner-dp 1 --actors 0 == the untouched
+    phase-locked Trainer.run, leaf-for-leaf bitwise, end to end through
+    the train.py CLI — wiring ALL the composition knobs at their off
+    settings changes no bit of the default schedule (the topology_gate
+    anchor)."""
+    from r2d2dpg_tpu import train
+    from r2d2dpg_tpu.utils import CheckpointManager
+    from r2d2dpg_tpu.utils.checkpoint import resume_state
+
+    t1 = PENDULUM_TINY.build()
+    warm, fill = t1.window_fill_phases, t1.replay_fill_phases
+    s1 = t1.run(
+        warm + fill + N_TRAIN, log_every=LOG_EVERY, log_fn=lambda *_: None
+    )
+
+    train.run(
+        train.parse_args(
+            [
+                "--config", "pendulum_tiny",
+                "--actors", "0",
+                "--replay-shards", "1",
+                "--learner-dp", "1",
+                "--phases", str(N_TRAIN),
+                "--log-every", str(LOG_EVERY),
+                "--checkpoint-dir", str(tmp_path / "ckpt"),
+                "--checkpoint-every", "-1",
+                "--watchdog", "0",
+            ]
+        )
+    )
+    t2 = PENDULUM_TINY.build()
+    s2 = resume_state(
+        t2, CheckpointManager(str(tmp_path / "ckpt"), save_every=-1)
+    )
+    bad = _leaves_equal(s1, s2)
+    assert not bad, f"state diverged at leaves {bad}"
+
+
+# ------------------------------------------------------ sampler+dp anchor
+def test_sampler_dp_learn_anchor_bitwise():
+    """The newly-legal sampler+dp pairing's determinism anchor: the
+    sampler learn program on a dp=1 mesh trainer — pulled [K, B] batch
+    placed via _put_staged(axis=1), outputs pinned replicated — produces
+    BITWISE the base trainer's updated params, priorities and metrics on
+    identical inputs (mesh placement is layout, never semantics)."""
+    base = PENDULUM_TINY.build()
+    dp = PENDULUM_TINY.build_dp_learner(make_mesh(1), collect_local=False)
+
+    def learn_once(trainer):
+        learner = SamplerLearner(
+            trainer, FleetConfig(num_actors=1), num_shards=1
+        )
+        try:
+            cfg = trainer.config
+            k, b = cfg.learner_steps, cfg.batch_size
+            rng = np.random.default_rng(7)
+            seq_len = trainer.agent.config.seq_len
+            from r2d2dpg_tpu.replay.arena import SequenceBatch
+
+            seqs = SequenceBatch(
+                obs=rng.normal(size=(k, b, seq_len, 3)).astype(np.float32),
+                action=rng.normal(size=(k, b, seq_len, 1)).astype(
+                    np.float32
+                ),
+                reward=rng.normal(size=(k, b, seq_len)).astype(np.float32),
+                discount=np.ones((k, b, seq_len), np.float32),
+                reset=np.zeros((k, b, seq_len), np.float32),
+                carries={
+                    "actor": jax.tree_util.tree_map(
+                        lambda x: np.zeros(
+                            (k, b) + x.shape[1:], np.asarray(x).dtype
+                        ),
+                        trainer.agent.actor.initial_carry(1),
+                    ),
+                    "critic": jax.tree_util.tree_map(
+                        lambda x: np.zeros(
+                            (k, b) + x.shape[1:], np.asarray(x).dtype
+                        ),
+                        trainer.agent.critic.initial_carry(1),
+                    ),
+                },
+            )
+            probs = np.full((k, b), 1.0 / 64, np.float32)
+            state = trainer.init()
+            train = state.train
+            seqs_p = trainer._put_staged(seqs, axis=1)
+            probs_p = trainer._put_staged(probs, axis=1)
+            train, prios, metrics = learner._learn_prog(
+                train, seqs_p, probs_p, np.float32(64), jax.random.PRNGKey(3)
+            )
+            return jax.device_get((train, prios, metrics))
+        finally:
+            # start() was never called; release the (unstarted) server's
+            # registry state by dropping the learner.
+            del learner
+
+    t_base, p_base, m_base = learn_once(base)
+    t_dp, p_dp, m_dp = learn_once(dp)
+    assert not _leaves_equal(t_base, t_dp)
+    assert np.array_equal(np.asarray(p_base), np.asarray(p_dp))
+    assert not _leaves_equal(m_base, m_dp)
+
+
+# ------------------------------------------------------------ composed e2e
+def test_composed_2x2x2_end_to_end_thread_actors():
+    """The full composition at real multiplicity on the forced host
+    devices: 2 thread actors -> 2 ingest-edge shards -> a dp=2 mesh
+    sampler learner.  Run completes its exact step schedule, counters
+    stay monotone, sheds == 0 (structural: ring eviction), the pulled
+    batches land dp-sharded, and the overlap instrumentation rides the
+    composed loop."""
+    from r2d2dpg_tpu.fleet.actor import FleetActor
+    from r2d2dpg_tpu.parallel.mesh import DP_AXIS
+
+    trainer = PENDULUM_TINY.build_dp_learner(make_mesh(2), collect_local=False)
+    learner = SamplerLearner(
+        trainer,
+        FleetConfig(num_actors=2, idle_timeout_s=120),
+        num_shards=2,
+    )
+    # The batch-axis placement contract, checked directly: axis=1 lays
+    # [K, B] over dp on the SECOND axis.
+    probe = trainer._put_staged(np.zeros((1, 8, 3), np.float32), axis=1)
+    assert tuple(probe.sharding.spec)[:2] == (None, DP_AXIS)
+    assert all(s is None for s in tuple(probe.sharding.spec)[2:])
+
+    address = learner.start()
+    threads = []
+    for i in range(2):
+        actor = FleetActor(
+            PENDULUM_TINY, actor_id=i, num_actors=2, address=address, seed=0
+        )
+
+        def loop(a=actor):
+            try:
+                a.run()  # stream until the server teardown cuts the socket
+            except Exception:  # noqa: BLE001
+                pass
+
+        th = threading.Thread(target=loop, daemon=True)
+        th.start()
+        threads.append(th)
+    logged = []
+    try:
+        state = learner.run(
+            N_TRAIN,
+            log_every=LOG_EVERY,
+            metrics_fn=lambda p, s: logged.append((p, dict(s))),
+        )
+    finally:
+        learner.close()
+        for th in threads:
+            th.join(timeout=30)
+    tc = trainer.config
+    assert int(state.train.step) == N_TRAIN * tc.learner_steps
+    stats = learner.stats()
+    assert stats["train_phases"] == N_TRAIN
+    assert stats["sheds"] == 0
+    assert stats["trained_seqs"] == N_TRAIN * tc.learner_steps * tc.batch_size
+    assert 0.0 <= stats["overlap_fraction"] <= 1.0
+    # Monotone counters through the bank, across both actors.
+    env_steps = [s["env_steps"] for _, s in logged]
+    assert env_steps == sorted(env_steps) and env_steps[-1] > 0
+    lsteps = [s["learner_steps"] for _, s in logged]
+    assert lsteps == sorted(lsteps)
+    assert [p for p, _ in logged] == [
+        p for p in range(1, N_TRAIN + 1) if p % LOG_EVERY == 0
+    ]
+
+
+# -------------------------------------------------------- lr/batch scaling
+def test_lr_scale_batch_linear_rule(capsys):
+    """--lr-scale-batch: doubling the batch doubles the resolved lrs
+    (linear rule, 1803.02811), stamped loudly through the real CLI run.
+    (The no-op scale-1.0 stamp shares the same print site — one CLI run
+    keeps the tier-1 budget; the scale arithmetic itself is pinned on
+    the 2x case.)"""
+    from r2d2dpg_tpu import train
+
+    train.run(
+        train.parse_args(
+            [
+                "--config", "pendulum_tiny",
+                "--phases", "1",
+                "--batch-size", "16",
+                "--lr-scale-batch", "1",
+                "--log-every", "0",
+            ]
+        )
+    )
+    out = capsys.readouterr().out
+    assert "lr-scale-batch: linear rule" in out
+    assert "batch 8 -> 16, scale 2" in out
