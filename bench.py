@@ -669,6 +669,17 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
             / max(rec["fleet_sampler"]["bytes_per_trained_seq"], 1e-9),
             2,
         )
+        # Standalone shard tier probe (ISSUE 12): same fleet shape, the
+        # 2 shards hosted OUT of process with a kill_shard drill mid-run
+        # — bytes/trained-seq across real sockets vs the loopback leg
+        # above, plus the kill->requota recovery latency.
+        rec["fleet_shard_procs"] = _shard_procs_leg(phases)
+        if "bytes_per_trained_seq" in rec["fleet_shard_procs"]:
+            rec["shard_procs_bytes_vs_loopback"] = round(
+                rec["fleet_shard_procs"]["bytes_per_trained_seq"]
+                / max(rec["fleet_sampler"]["bytes_per_trained_seq"], 1e-9),
+                2,
+            )
         # Multi-chip learner probe (ISSUE 9): --learner-dp over a forced
         # 2-virtual-device CPU mesh (subprocess legs), dp=1 vs dp=2 at
         # equal fleet size, through the full train.py CLI wiring.
@@ -740,6 +751,28 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
     print(json.dumps(rec))
 
 
+def _parse_fleet_stats(stdout: str) -> dict:
+    """Parse the end-of-run ``fleet: <k v ...>`` stats line out of a train
+    CLI subprocess's stdout — "fleet: ingest on HOST:PORT" and
+    "fleet: WARNING ..." share the prefix but not the keys, so only the
+    line carrying ``train_phases`` counts.  ONE definition for every
+    subprocess bench leg (learner-dp / composed / shard-procs): a stats-
+    line format change is a one-site fix."""
+    stats = {}
+    for line in stdout.splitlines():
+        if not line.startswith("fleet: ") or "train_phases" not in line:
+            continue
+        toks = line[len("fleet: "):].split()
+        try:
+            stats = {
+                toks[i]: float(toks[i + 1])
+                for i in range(0, len(toks) - 1, 2)
+            }
+        except ValueError:
+            continue
+    return stats
+
+
 def _learner_dp_leg(dp: int, phases: int) -> dict:
     """One ``--learner-dp`` leg of the fleet probe (ISSUE 9), in a
     SUBPROCESS: the dp mesh needs ``XLA_FLAGS=
@@ -776,20 +809,7 @@ def _learner_dp_leg(dp: int, phases: int) -> dict:
         )
     except subprocess.TimeoutExpired:
         return {"error": "learner-dp leg exceeded 900s"}
-    stats = {}
-    for line in out.stdout.splitlines():
-        # Only the end-of-run stats line — "fleet: ingest on HOST:PORT"
-        # and "fleet: WARNING ..." share the prefix but not the keys.
-        if not line.startswith("fleet: ") or "train_phases" not in line:
-            continue
-        toks = line[len("fleet: "):].split()
-        try:
-            stats = {
-                toks[i]: float(toks[i + 1])
-                for i in range(0, len(toks) - 1, 2)
-            }
-        except ValueError:
-            continue
+    stats = _parse_fleet_stats(out.stdout)
     if not stats:
         return {"error": f"rc={out.returncode}: {out.stderr[-300:]}"}
     leg = {
@@ -858,23 +878,13 @@ def _composed_leg(phases: int = 12) -> dict:
         )
     except subprocess.TimeoutExpired:
         return {"error": "composed leg exceeded 900s"}
-    stats = {}
+    stats = _parse_fleet_stats(out.stdout)
     lr_note = topo_note = ""
     for line in out.stdout.splitlines():
         if line.startswith("lr-scale-batch: "):
             lr_note = line[len("lr-scale-batch: "):]
         if line.startswith("topology: "):
             topo_note = line[len("topology: "):]
-        if not line.startswith("fleet: ") or "train_phases" not in line:
-            continue
-        toks = line[len("fleet: "):].split()
-        try:
-            stats = {
-                toks[i]: float(toks[i + 1])
-                for i in range(0, len(toks) - 1, 2)
-            }
-        except ValueError:
-            continue
     if not stats:
         return {"error": f"rc={out.returncode}: {out.stderr[-300:]}"}
     leg = {
@@ -894,6 +904,107 @@ def _composed_leg(phases: int = 12) -> dict:
         "sheds": stats.get("sheds", -1.0),
         "replay_occupancy": stats.get("replay_occupancy", 0.0),
         "overlap_fraction": round(stats.get("overlap_fraction", 0.0), 3),
+    }
+    if out.returncode != 0:
+        leg["error"] = f"rc={out.returncode}: {out.stderr[-300:]}"
+    return leg
+
+
+def _shard_procs_leg(phases: int = 12) -> dict:
+    """``python bench.py fleet_shard_procs`` — the standalone shard tier
+    (ISSUE 12): ``--actors 3 --replay-shards 2 --shard-procs 2`` through
+    the real train.py CLI in a subprocess, with a ``kill_shard`` chaos
+    drill injected mid-run so the leg records the tier's RECOVERY
+    latency, not just its throughput.
+
+    Records ``bytes_per_trained_seq`` across REAL shard sockets (the
+    loopback leg ``fleet_sampler`` is the comparison denominator:
+    identical frames, so the delta is socket/ack overhead plus the
+    HELLO/advert traffic), ``shard_forward_bytes_total`` (the
+    ingest->shard SEQS hop the loopback doesn't pay — the honest cost of
+    the extra localhost hop; ROADMAP names shedding it via direct
+    actor->shard dials as the elasticity seam), and
+    ``time_to_requota_s``: the gap between the kill_shard injection and
+    the ``shard_dead``/``shard_quota_renorm`` verdict (both stamped
+    ``t_mono`` in flight.jsonl) — how long a dead replay node degrades
+    sampling before quotas renormalize to the survivors.
+
+    HONESTY (carried from the other fleet legs): this single-core
+    container time-slices the learner, 3 actor processes and 2 shard
+    processes, so rates are contention artifacts; the claims this leg
+    records are sheds=0, run completion THROUGH a shard kill, and the
+    recovery latency."""
+    import json as _json
+    import tempfile
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("R2D2DPG_PALLAS_INTERPRET", "1")
+    logdir = tempfile.mkdtemp(prefix="bench_shard_procs_")
+    cmd = [
+        sys.executable, "-m", "r2d2dpg_tpu.train",
+        "--config", "pendulum_r2d2", "--num-envs", "64",
+        "--actors", "3", "--replay-shards", "2", "--shard-procs", "2",
+        "--fleet-publish-every", "4",
+        # The probe's fast lane (bf16+zlib), so bytes_per_trained_seq is
+        # lane-matched against the recorded loopback leg fleet_sampler —
+        # the delta is then socket/ack/advert overhead, not encoding.
+        "--fleet-wire", "bf16", "--fleet-compress", "zlib",
+        "--chaos-spec", f"kill_shard@p{max(phases // 2, 1)}",
+        "--phases", str(phases), "--log-every", "0",
+        "--logdir", logdir,
+    ]
+    try:
+        out = subprocess.run(
+            cmd, env=env, cwd=HERE, capture_output=True, text=True,
+            timeout=900,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "shard-procs leg exceeded 900s"}
+    stats = _parse_fleet_stats(out.stdout)
+    if not stats:
+        return {"error": f"rc={out.returncode}: {out.stderr[-300:]}"}
+    # Recovery latency off the flight timeline: kill injection ->
+    # shard_dead (+ the quota renorm recorded in the same breath).
+    t_kill = t_dead = None
+    try:
+        with open(os.path.join(logdir, "flight.jsonl")) as fh:
+            for line in fh:
+                try:
+                    e = _json.loads(line)
+                except ValueError:
+                    continue
+                if (
+                    e.get("kind") == "chaos_inject"
+                    and e.get("fault") == "kill_shard"
+                ):
+                    t_kill = e.get("t_mono")
+                if e.get("kind") == "shard_dead" and t_dead is None:
+                    t_dead = e.get("t_mono")
+    except OSError:
+        pass
+    leg = {
+        "trained_seqs": stats.get("trained_seqs", 0.0),
+        "sheds": stats.get("sheds", -1.0),
+        "bytes_per_trained_seq": round(
+            stats.get("bytes_per_trained_seq", 0.0), 1
+        ),
+        "sample_bytes_total": stats.get("sample_bytes_total", 0.0),
+        "shard_forward_bytes_total": stats.get(
+            "shard_forward_bytes_total", 0.0
+        ),
+        "shard_deaths": stats.get("shard_deaths", 0.0),
+        "shard_rejoins": stats.get("shard_rejoins", 0.0),
+        "evictions": stats.get("evictions", 0.0),
+        "learner_steps_per_sec": round(
+            stats.get("train_learner_steps_per_sec", 0.0), 2
+        ),
+        "time_to_requota_s": (
+            round(t_dead - t_kill, 3)
+            if t_kill is not None and t_dead is not None and t_dead >= t_kill
+            else None
+        ),
     }
     if out.returncode != 0:
         leg["error"] = f"rc={out.returncode}: {out.stderr[-300:]}"
@@ -1025,5 +1136,10 @@ if __name__ == "__main__":
         # ONE JSON object — merge it into BENCH_FLEET.json's
         # "fleet_composed" key beside the single-axis legs.
         print(json.dumps({"fleet_composed": _composed_leg()}))
+    elif len(sys.argv) > 1 and sys.argv[1] == "fleet_shard_procs":
+        # Just the standalone-shard-tier leg (ISSUE 12; subprocess,
+        # CPU-local, kill_shard drill included): ONE JSON object — merge
+        # into BENCH_FLEET.json's "fleet_shard_procs" key.
+        print(json.dumps({"fleet_shard_procs": _shard_procs_leg()}))
     else:
         main()

@@ -20,8 +20,14 @@ tests/test_fleet.py.
 - ``sampler``    — in-network experience sampling (``--replay-shards N``,
   ISSUE 10): replay sharded at the ingest edge, learner-pulled batches
   over SAMPLE_REQ/BATCH/PRIO frames (docs/REPLAY.md).
+- ``shard``      — the standalone crash-tolerant shard tier
+  (``--shard-procs N``, ISSUE 12): each replay shard as a supervised
+  process behind its own listening socket, with quota renormalization
+  on shard loss and epoch-fenced rejoin (``python -m
+  r2d2dpg_tpu.fleet.shard``).
 - ``supervisor`` — spawn/monitor/restart-with-backoff for the actor
-  subprocesses; crashes land in the flight recorder.
+  (and shard, ``role="shard"``) subprocesses; crashes land in the
+  flight recorder.
 - ``chaos``      — seeded fault-injection drills at the fleet's real
   boundaries (SIGKILL / stall / byte flip / socket close), each asserting
   its documented recovery (ISSUE 7).
@@ -43,6 +49,11 @@ from r2d2dpg_tpu.fleet.sampler import (
     ShardSet,
     shard_for_actor,
 )
+from r2d2dpg_tpu.fleet.shard import (
+    RemoteShardSet,
+    ShardProcTier,
+    ShardServer,
+)
 from r2d2dpg_tpu.fleet.supervisor import (
     ActorSupervisor,
     SupervisorConfig,
@@ -57,7 +68,10 @@ __all__ = [
     "FleetConfig",
     "FleetLearner",
     "IngestServer",
+    "RemoteShardSet",
     "SamplerLearner",
+    "ShardProcTier",
+    "ShardServer",
     "ShardSet",
     "SupervisorConfig",
     "WireConfig",

@@ -23,6 +23,20 @@ fault                injection boundary             documented recovery
 ``kill_ingest_conn`` learner-side socket close      actor reconnect-with-
                      (``IngestServer.               backoff + fresh HELLO/
                      drop_connection``)             param snapshot
+``kill_shard``       supervisor SIGKILL of a        quota renormalization
+                     standalone shard process       over survivors + handler
+                     (``ShardProcTier.kill_proc``)  re-route, then backoff
+                                                    restart + epoch-fenced
+                                                    rejoin (``shard_dead`` ->
+                                                    ``shard_rejoin``)
+``stall_shard``      in-shard-process response      both legs wait it out:
+                     gate (``ShardChaos.gate``,     zero sheds, zero false
+                     fleet/shard.py)                reaps (the pinned
+                                                    property)
+``partition_shard``  learner-side drop of BOTH      reconnect both legs;
+                     legs' connections to one       shard data survives
+                     shard (``RemoteShardSet.       under the SAME epoch (a
+                     partition``)                   partition ≠ a restart)
 ===================  =============================  ========================
 
 **Spec grammar** (``--chaos-spec``)::
@@ -58,6 +72,7 @@ import json
 import os
 import re
 import socket
+import threading
 import time
 import zlib
 from typing import Optional, Sequence, Tuple
@@ -75,17 +90,42 @@ from r2d2dpg_tpu.obs import flight_event, get_flight_recorder, get_registry
 # learner's own pull loop for its duration (recovery: nothing to recover —
 # shards keep absorbing under their own locks and ring-evict instead of
 # shedding, which is exactly the property the drill pins).
+# ``kill_shard``/``stall_shard``/``partition_shard`` drill the standalone
+# shard tier (fleet/shard.py, ISSUE 12): SIGKILL of a shard process
+# (recovery: quota renormalization over survivors + handler re-route,
+# then epoch-fenced rejoin after the supervisor's backoff restart), an
+# in-shard-process response stall (recovery: nothing — both legs wait it
+# out, zero sheds and zero false reaps), and a learner-side drop of BOTH
+# legs' connections to one shard (recovery: reconnect; the shard's data
+# survives under the SAME epoch — a partition is not a restart).
 LEARNER_FAULTS = frozenset(
-    {"kill_actor", "kill_ingest_conn", "kill_sampler_conn", "stall_sampler"}
+    {
+        "kill_actor",
+        "kill_ingest_conn",
+        "kill_sampler_conn",
+        "stall_sampler",
+        "kill_shard",
+        "partition_shard",
+    }
 )
 ACTOR_FAULTS = frozenset({"stall_actor", "corrupt_frame"})
+# Faults fired INSIDE a standalone shard process (fleet/shard.py parses
+# the forwarded --chaos-spec; the clock is SEQS frames that process has
+# absorbed).  ``kill_shard`` targets a shard PROCESS index (the
+# supervisor's SIGKILL unit); ``partition_shard`` targets a SHARD index
+# (the connection unit); ``stall_shard`` targets a process index.
+SHARD_PROC_FAULTS = frozenset({"stall_shard"})
 # The sampler peer class: train.py refuses these without --replay-shards
 # (on the central drain a "sampler stall" would stall the DRAIN thread
 # and shed — evidence for an invariant that path cannot exhibit).
 SAMPLER_FAULTS = frozenset({"kill_sampler_conn", "stall_sampler"})
-FAULT_KINDS = tuple(sorted(LEARNER_FAULTS | ACTOR_FAULTS))
+# The shard-tier class: refused without --shard-procs (the loopback
+# shards share the learner's process — there is no shard to kill,
+# partition, or stall independently of the learner itself).
+SHARD_FAULTS = frozenset({"kill_shard", "stall_shard", "partition_shard"})
+FAULT_KINDS = tuple(sorted(LEARNER_FAULTS | ACTOR_FAULTS | SHARD_PROC_FAULTS))
 # Faults that carry (and require) a :Ds duration suffix.
-STALL_FAULTS = frozenset({"stall_actor", "stall_sampler"})
+STALL_FAULTS = frozenset({"stall_actor", "stall_sampler", "stall_shard"})
 
 _FAULT_RE = re.compile(
     r"^(?P<kind>[a-z_]+)@p(?P<phase>\d+)(?::(?P<dur>\d+(?:\.\d+)?)s)?$"
@@ -220,12 +260,18 @@ class ChaosEngine:
         num_actors: int,
         supervisor=None,
         server=None,
+        shard_tier=None,
     ):
         self.faults = tuple(faults)
         self.seed = seed
         self.num_actors = num_actors
         self.supervisor = supervisor
         self.server = server
+        # The standalone shard tier (fleet/shard.py ShardProcTier, ISSUE
+        # 12): the kill_shard boundary is its supervisor's SIGKILL
+        # (``kill_proc``), the partition_shard boundary its shard map's
+        # both-legs connection drop (``partition``).
+        self.shard_tier = shard_tier
         self._fired = set()
         _drill_counter()  # register the family before any drill fires
 
@@ -286,6 +332,34 @@ class ChaosEngine:
                     duration_s=fault.duration_s,
                 )
                 time.sleep(fault.duration_s)
+            elif fault.kind == "kill_shard":
+                # SIGKILL one standalone shard PROCESS (target re-derived
+                # modulo the tier's proc count): the drill the whole tier
+                # exists to survive — quotas renormalize to the survivors
+                # within a phase, handlers re-route, and the supervisor's
+                # backoff restart rejoins the shard under a bumped epoch.
+                tier = self.shard_tier
+                if tier is None:
+                    continue
+                target = fault_target(fault, self.seed, tier.num_procs)
+                if not tier.kill_proc(target):
+                    continue
+                self._fired.add(fault.index)
+                record_injection(fault, target, at_phase=phase)
+            elif fault.kind == "partition_shard":
+                # Drop BOTH legs' connections to one shard (target modulo
+                # the SHARD count — the connection unit): a network
+                # partition, not a restart.  Recovery is reconnection on
+                # both legs with the shard's data intact under the SAME
+                # epoch (tests/test_shard.py pins that distinction).
+                tier = self.shard_tier
+                if tier is None:
+                    continue
+                target = fault_target(fault, self.seed, tier.num_shards)
+                if not tier.shard_set.partition(target):
+                    continue  # no live connection yet: stays pending
+                self._fired.add(fault.index)
+                record_injection(fault, target, at_phase=phase)
 
     def unfired(self) -> Tuple[Fault, ...]:
         """Learner-side faults whose phase never arrived (run too short):
@@ -298,27 +372,25 @@ class ChaosEngine:
         )
 
 
-def actor_faults_unfired(
-    faults: Sequence[Fault], logdir: str, *, seed: int, num_actors: int
+def _faults_unfired_in_dumps(
+    faults: Sequence[Fault],
+    logdir: str,
+    *,
+    pattern: str,
+    kinds: frozenset,
+    seed: int,
+    n: int,
 ) -> Tuple[Fault, ...]:
-    """Actor-boundary faults of a spec with NO injection evidence in the
-    ``flight_actor*.jsonl`` dumps under ``logdir``.
-
-    The learner-side engine cannot see an actor process fire (or fail to
-    fire) its drills; what it CAN see, after teardown has flushed every
-    incarnation's dump, is whether a ``chaos_inject`` line exists for each
-    scheduled actor-side fault — ``record_injection`` flushes at injection
-    time precisely so this evidence survives any way the drill ends.
-    Evidence is matched on (kind, phase, target actor) — ``seed`` and
-    ``num_actors`` recompute each fault's target — so duplicate spec
-    entries hashing to different actors each need their own line.
-    Callers warn on the returned faults: a drill that left no evidence
-    must not read as one that passed (the ``unfired()`` contract)."""
-    expected = [f for f in faults if f.kind in ACTOR_FAULTS]
+    """The shared no-evidence-means-unfired scan: faults of ``kinds``
+    with no ``chaos_inject`` line in the ``pattern`` flight dumps under
+    ``logdir``.  Evidence is matched on (kind, phase, target) — ``seed``
+    and ``n`` recompute each fault's target — so duplicate spec entries
+    hashing to different targets each need their own line."""
+    expected = [f for f in faults if f.kind in kinds]
     if not expected:
         return ()
     seen = set()
-    for path in glob.glob(os.path.join(logdir, "flight_actor*.jsonl")):
+    for path in glob.glob(os.path.join(logdir, pattern)):
         try:
             with open(path) as fh:
                 for line in fh:
@@ -335,7 +407,30 @@ def actor_faults_unfired(
     return tuple(
         f
         for f in expected
-        if (f.kind, f.phase, fault_target(f, seed, num_actors)) not in seen
+        if (f.kind, f.phase, fault_target(f, seed, n)) not in seen
+    )
+
+
+def actor_faults_unfired(
+    faults: Sequence[Fault], logdir: str, *, seed: int, num_actors: int
+) -> Tuple[Fault, ...]:
+    """Actor-boundary faults of a spec with NO injection evidence in the
+    ``flight_actor*.jsonl`` dumps under ``logdir``.
+
+    The learner-side engine cannot see an actor process fire (or fail to
+    fire) its drills; what it CAN see, after teardown has flushed every
+    incarnation's dump, is whether a ``chaos_inject`` line exists for each
+    scheduled actor-side fault — ``record_injection`` flushes at injection
+    time precisely so this evidence survives any way the drill ends.
+    Callers warn on the returned faults: a drill that left no evidence
+    must not read as one that passed (the ``unfired()`` contract)."""
+    return _faults_unfired_in_dumps(
+        faults,
+        logdir,
+        pattern="flight_actor*.jsonl",
+        kinds=ACTOR_FAULTS,
+        seed=seed,
+        n=num_actors,
     )
 
 
@@ -390,3 +485,80 @@ class ActorChaos:
             and f.index not in self._fired
             and batch_idx >= f.phase
         ]
+
+
+class ShardChaos:
+    """Shard-process-side scheduler (fleet/shard.py, ISSUE 12): the
+    ``stall_shard`` faults of a forwarded spec that target THIS shard
+    process, fired on its absorbed-SEQS-frame clock.
+
+    The stall is a RESPONSE gate, not a sleep in one handler: every leg's
+    handler waits out the gate before replying (acks, BATCH responses),
+    so for the duration the whole shard is unresponsive on every
+    connection — exactly what a GC pause or an I/O wedge looks like from
+    the learner side.  The drill's pinned property is that NOTHING breaks:
+    actors keep streaming into the (eventually-answered) ack wait, the
+    sampler waits out its exchange, zero sheds, zero false reaps."""
+
+    def __init__(
+        self,
+        faults: Sequence[Fault],
+        *,
+        seed: int,
+        num_shard_procs: int,
+        proc_index: int,
+    ):
+        self.proc_index = proc_index
+        self._mine = tuple(
+            f
+            for f in faults
+            if f.kind in SHARD_PROC_FAULTS
+            and fault_target(f, seed, num_shard_procs) == proc_index
+        )
+        self._fired = set()
+        self._frames = 0
+        self._stall_until = 0.0
+        self._lock = threading.Lock()
+
+    def on_seqs_frame(self) -> None:
+        """One absorbed SEQS frame (any connection): advance the clock and
+        arm any due stall (recorded at arm time — evidence survives
+        however the drill ends)."""
+        with self._lock:
+            self._frames += 1
+            for f in self._mine:
+                if f.index in self._fired or self._frames < f.phase:
+                    continue
+                self._fired.add(f.index)
+                record_injection(
+                    f, self.proc_index, at_phase=self._frames,
+                    duration_s=f.duration_s,
+                )
+                self._stall_until = max(
+                    self._stall_until, time.monotonic() + f.duration_s
+                )
+
+    def gate(self) -> None:
+        """Wait out any armed stall before replying (every handler calls
+        this ahead of each ACK/BATCH send)."""
+        delay = self._stall_until - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+
+
+def shard_faults_unfired(
+    faults: Sequence[Fault], logdir: str, *, seed: int, num_shard_procs: int
+) -> Tuple[Fault, ...]:
+    """Shard-process-boundary faults of a spec with NO injection evidence
+    in the ``flight_shard*.jsonl`` dumps under ``logdir`` — the
+    ``actor_faults_unfired`` contract extended to the shard tier (a
+    stall drill that never got its frame count must not read as one that
+    passed)."""
+    return _faults_unfired_in_dumps(
+        faults,
+        logdir,
+        pattern="flight_shard*.jsonl",
+        kinds=SHARD_PROC_FAULTS,
+        seed=seed,
+        n=num_shard_procs,
+    )

@@ -153,6 +153,14 @@ class IngestServer:
         # (consistent-hash routing assigned at HELLO) under that shard's
         # own lock, so N handlers add concurrently and NOTHING sheds
         # (a full shard ring FIFO-evicts re-collectable experience).
+        # The standalone tier (fleet/shard.py ``RemoteShardSet``,
+        # ISSUE 12) plugs in through the same two-call contract —
+        # ``route(actor)`` at HELLO, ``add(shard_id, msg)`` per frame —
+        # with ``add`` forwarding the experience over the shard's socket
+        # (re-routing to survivors on shard death; the accounting deltas
+        # bank learner-side inside ``add`` either way, so a dead shard
+        # can never lose step/episode sums).  This handler is agnostic
+        # to where replay lives.
         self.shards = shards
         self._request_address = address
         self.shed_after_s = shed_after_s
@@ -678,11 +686,6 @@ class IngestServer:
             # Accepted actor: staleness is visible from THIS moment, not
             # from its first well-formed TELEM (which may never come).
             self._arm_telem_staleness(actor)
-            # Sharded-replay routing is per ACTOR ID, not per connection:
-            # a reconnecting incarnation keeps feeding the same shard.
-            shard_id = (
-                self.shards.route(actor) if self.shards is not None else None
-            )
             sent_version = self._push_params_if_stale(conn, 0, bytes_out)
             bytes_out.inc(
                 send_frame(
@@ -778,7 +781,13 @@ class IngestServer:
                     # shard — concurrent across handlers, never sheds
                     # (ring eviction is the backpressure), accounting
                     # deltas banked for the sampler learner's sums.
-                    self.shards.add(shard_id, msg)
+                    # Routed per FRAME, not per connection: the route is
+                    # a pure actor-id hash on the loopback (identical
+                    # every call), and liveness-aware on the standalone
+                    # tier — an actor whose home shard was down at HELLO
+                    # lands back home the moment it rejoins, instead of
+                    # feeding a neighbor for the connection's lifetime.
+                    self.shards.add(self.shards.route(actor), msg)
                     code = OK
                     with self._lock:
                         self.seqs_total += n_seqs

@@ -137,12 +137,24 @@ class ShardSet:
     ):
         if num_shards < 1:
             raise ValueError("num_shards must be >= 1")
+        # Eviction visibility (ISSUE 12 satellite): ring FIFO-overwrites
+        # replaced shedding in PR 10 but previously left no trace — the
+        # labeled counter (bumped under the shard's own add lock via
+        # evict_cb) plus the sampler stats' ``evictions`` column make
+        # silent experience recycling a first-class signal.
+        evict = get_registry().counter(
+            "r2d2dpg_replay_shard_evictions_total",
+            "filled replay-shard slots FIFO-overwritten by the ring "
+            "(re-collectable experience recycled before it was sampled)",
+            labelnames=("shard",),
+        )
         self.shards = [
             ReplayShard(
                 shard_capacity,
                 alpha=alpha,
                 prioritized=prioritized,
                 shard_id=i,
+                evict_cb=evict.labels(shard=str(i)).inc,
             )
             for i in range(num_shards)
         ]
@@ -203,6 +215,9 @@ class ShardSet:
     def scaled_sums(self) -> np.ndarray:
         return np.asarray([s.scaled_sum() for s in self.shards], np.float64)
 
+    def evictions_total(self) -> int:
+        return sum(s.evictions_total for s in self.shards)
+
 
 class SamplerLearner:
     """The learner side of in-network sampling (``--replay-shards N``).
@@ -229,6 +244,7 @@ class SamplerLearner:
         *,
         num_shards: int,
         total_capacity: Optional[int] = None,
+        shard_set=None,
     ):
         if trainer.axis is not None:
             raise ValueError(
@@ -265,12 +281,27 @@ class SamplerLearner:
         self.trainer = trainer
         self.config = config
         self.num_shards = num_shards
-        self.shards = ShardSet(
-            num_shards,
-            cap // num_shards,
-            alpha=trainer.config.priority_alpha,
-            prioritized=trainer.config.prioritized,
-        )
+        # Where replay LIVES is deployment, not semantics (ISSUE 12): the
+        # default is the in-learner loopback ShardSet (PR 10's path,
+        # pinned bit-identical through the CLI); a ``shard_set`` — the
+        # standalone tier's RemoteShardSet (fleet/shard.py, behind
+        # train.py --shard-procs N) — swaps every shard interaction onto
+        # real sockets while this class's lifecycle stays identical.
+        self._remote = shard_set is not None
+        if self._remote:
+            if shard_set.num_shards != num_shards:
+                raise ValueError(
+                    f"shard_set has {shard_set.num_shards} shards, "
+                    f"expected {num_shards}"
+                )
+            self.shards = shard_set
+        else:
+            self.shards = ShardSet(
+                num_shards,
+                cap // num_shards,
+                alpha=trainer.config.priority_alpha,
+                prioritized=trainer.config.prioritized,
+            )
         # The ingest server routes SEQS straight into the shards; its
         # staging queue exists only structurally (nothing ever enqueues,
         # so nothing can shed — ring eviction is the backpressure).
@@ -340,6 +371,10 @@ class SamplerLearner:
             "bytes crossing the sampling boundary (SAMPLE_REQ + BATCH + "
             "PRIO frames, headers included)",
         )
+        if self._remote:
+            # The honest sampling-boundary byte count now includes real
+            # socket traffic (REQ/BATCH/PRIO + their acks + HELLOs).
+            self.shards.bind_sample_bytes(self._obs_bytes.inc)
         self._stats: Dict[str, float] = {}
         self._counters: Dict[str, float] = {}
 
@@ -411,6 +446,8 @@ class SamplerLearner:
         with the concatenated draws PERMUTED (seeded) before the caller
         reshapes to ``[K, B]`` — quota counts are per shard, and without
         the shuffle update k would correlate with shard identity."""
+        if self._remote:
+            return self._pull_phase_batches_remote(n_draws, rng)
         sums = self.shards.scaled_sums()
         quotas = shard_quotas(sums, n_draws, rng)
         total = float(sums.sum())
@@ -474,9 +511,153 @@ class SamplerLearner:
             self.shards.occupancy_total(),
         )
 
+    def _pull_phase_batches_remote(self, n_draws: int, rng: np.random.Generator):
+        """The ``--shard-procs`` pull: same two-level math, real sockets,
+        plus the graceful-degradation contract — a shard whose exchange
+        fails mid-phase is marked dead, its quota redistributed over the
+        SURVIVORS' advertised Σp^α within this very phase (the
+        renormalization acceptance), and a fully-dead tier is waited out
+        (bounded by ``idle_timeout_s``) while the supervisor restarts it.
+        Handles carry each batch's shard EPOCH so the write-back can
+        fence a restart that happens between sample and verdict."""
+        from r2d2dpg_tpu.fleet.shard import ShardUnavailableError
+
+        shards = self.shards
+        shards.maybe_rejoin()
+        seqs: List[SequenceBatch] = []
+        probs: List[np.ndarray] = []
+        shard_of: List[np.ndarray] = []
+        slots: List[np.ndarray] = []
+        gens: List[np.ndarray] = []
+        epochs: List[np.ndarray] = []
+        remaining = int(n_draws)
+        deadline = time.monotonic() + self.config.idle_timeout_s
+        while remaining > 0:
+            sums = shards.scaled_sums()
+            total = float(sums.sum())
+            if total <= 0.0:
+                # Every shard dead or freshly-rejoined-empty: degrade by
+                # WAITING (sampling stalls, training pauses, actors keep
+                # streaming into re-routed/absorbing shards) — never by
+                # fabricating draws.
+                if time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        "sampler starved: no live non-empty replay shard "
+                        "to draw from (shard tier down past "
+                        f"{self.config.idle_timeout_s:.0f}s — check "
+                        "flight.jsonl for shard_dead/shard_crash events)"
+                    )
+                shards.maybe_rejoin()
+                time.sleep(0.1)
+                continue
+            quotas = shard_quotas(sums, remaining, rng)
+            remaining = 0
+            for shard_id, quota in enumerate(quotas):
+                if quota == 0:
+                    continue
+                self._req_id += 1
+                try:
+                    resp = shards.shards[shard_id].sample(
+                        int(quota), self._req_id
+                    )
+                except ShardUnavailableError as e:
+                    # The mid-phase degradation moment: the dead shard's
+                    # draws go back into the pool; the NEXT loop
+                    # iteration's quota draw sees its weight zeroed
+                    # (``_mark_dead`` records the renormalization) — the
+                    # phase still delivers its full n_draws, from the
+                    # survivors.
+                    shards._mark_dead(shard_id, str(e))
+                    flight_event(
+                        "shard_draws_redistributed",
+                        shard=shard_id,
+                        redistributed_draws=int(quota),
+                    )
+                    remaining += int(quota)
+                    continue
+                if resp is None:
+                    # LIVE but empty (a stale quota weight met a freshly
+                    # restarted ring): not a death — the ack's advert
+                    # zeroed its weight, so the re-draw below lands on
+                    # shards that actually hold data.
+                    remaining += int(quota)
+                    continue
+                seqs.append(resp["staged"].seq)
+                probs.append(
+                    combine_probs(resp["probs"], float(sums[shard_id]), total)
+                )
+                n_got = int(resp["slots"].shape[0])
+                shard_of.append(np.full(n_got, shard_id, np.int64))
+                slots.append(np.asarray(resp["slots"], np.int64))
+                gens.append(np.asarray(resp["gens"], np.int64))
+                epochs.append(np.full(n_got, int(resp["epoch"]), np.int64))
+        seq = jax.tree_util.tree_map(
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
+            *seqs,
+        )
+        perm = rng.permutation(n_draws)
+        seq = jax.tree_util.tree_map(lambda x: x[perm], seq)
+        return (
+            seq,
+            np.concatenate(probs)[perm],
+            (
+                np.concatenate(shard_of)[perm],
+                np.concatenate(slots)[perm],
+                np.concatenate(gens)[perm],
+                np.concatenate(epochs)[perm],
+            ),
+            self.shards.occupancy_total(),
+        )
+
+    def _write_back_remote(self, handles, prios: np.ndarray) -> None:
+        """TD write-back to standalone shards, grouped per (shard, epoch):
+        a shard that died since the sample drops its verdict loudly
+        (re-collectable, like the experience itself), and handles whose
+        epoch no longer matches the shard's live incarnation are fenced
+        LEARNER-side before a byte crosses — the shard's own epoch check
+        (``ShardServer``) remains the authoritative backstop."""
+        from r2d2dpg_tpu.fleet.shard import ShardUnavailableError
+
+        shard_of, slots, gens, epochs = handles
+        prios = np.asarray(prios, np.float32).reshape(-1)
+        for shard_id in np.unique(shard_of):
+            sh = self.shards.shards[int(shard_id)]
+            m_shard = shard_of == shard_id
+            for ep in np.unique(epochs[m_shard]):
+                m = m_shard & (epochs == ep)
+                if not sh.alive:
+                    flight_event(
+                        "prio_dropped_shard_dead",
+                        shard=int(shard_id),
+                        entries=int(m.sum()),
+                    )
+                    continue
+                if sh.epoch != int(ep):
+                    flight_event(
+                        "stale_epoch_prio_dropped",
+                        shard=int(shard_id),
+                        got_epoch=int(ep),
+                        epoch=sh.epoch,
+                        entries=int(m.sum()),
+                    )
+                    continue
+                try:
+                    sh.write_back(
+                        slots[m], gens[m], prios[m], epoch=int(ep)
+                    )
+                except ShardUnavailableError as e:
+                    self.shards._mark_dead(int(shard_id), str(e))
+                    flight_event(
+                        "prio_dropped_shard_dead",
+                        shard=int(shard_id),
+                        entries=int(m.sum()),
+                    )
+
     def _write_back(self, handles, prios: np.ndarray) -> None:
         """TD write-back through PRIO frames, grouped per shard; stale
         generations (ring-evicted slots) are ignored shard-side."""
+        if self._remote:
+            return self._write_back_remote(handles, prios)
         shard_of, slots, gens = handles
         prios = np.asarray(prios, np.float32).reshape(-1)
         for shard_id in np.unique(shard_of):
@@ -728,6 +909,10 @@ class SamplerLearner:
             srv = self.server
             drained_here = drained - drained_at_start
             trained = drained_here * n_draws
+            if self._remote:
+                # Real-socket accounting: the shard set counted every
+                # sampler-leg byte (REQ/BATCH/PRIO + acks + HELLOs).
+                self.sample_bytes_total = self.shards.sample_bytes_total
             self._counters = {
                 "drained": float(drained),
                 "env_steps_total": env_steps_total,
@@ -756,6 +941,10 @@ class SamplerLearner:
                 "seqs_bytes_total": float(srv.seqs_bytes_total),
                 "collected_seqs": float(srv.seqs_received_total),
                 "sheds": float(srv.shed_total),  # structurally 0
+                # Eviction visibility (ISSUE 12 satellite): ring FIFO
+                # overwrites of filled slots — the quantity shedding
+                # turned into in PR 10, now first-class in the stats row.
+                "evictions": float(self.shards.evictions_total()),
                 "replay_occupancy": float(self.shards.occupancy_total()),
                 "sampler_wait_p50_ms": sw_p50 * 1e3,
                 "sampler_wait_p99_ms": sw_p99 * 1e3,
@@ -767,6 +956,17 @@ class SamplerLearner:
                 # definition as PipelineExecutor.stats / FleetLearner).
                 "overlap_fraction": max(0.0, 1.0 - sw_total / wall),
             }
+            if self._remote:
+                # The standalone tier's robustness ledger (ISSUE 12).
+                self._stats.update(
+                    {
+                        "shard_deaths": float(self.shards.deaths_total),
+                        "shard_rejoins": float(self.shards.rejoins_total),
+                        "shard_forward_bytes_total": float(
+                            self.shards.forward_bytes_total
+                        ),
+                    }
+                )
             if train_t0 is not None:
                 train_wall = max(t_end - train_t0, 1e-9)
                 self._stats["train_wall_s"] = train_wall

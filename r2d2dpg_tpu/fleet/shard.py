@@ -1,0 +1,1420 @@
+"""Standalone crash-tolerant replay shard tier (ISSUE 12).
+
+PR 10's replay shards live INSIDE the learner process: the SAMPLE_REQ/
+BATCH/PRIO frames are real, but the tier has exactly one failure domain —
+kill the learner and you kill replay, which is precisely what the Ape-X
+separation of actors/replay/learner (PAPERS.md 1803.00933) and Reverb's
+standalone replay service (2110.13506) exist to avoid.  This module pushes
+each ``replay.sharded.ReplayShard`` out into a supervised shard PROCESS::
+
+    python -m r2d2dpg_tpu.fleet.shard --shard-ids 0,1 --capacity 64 ...
+
+    actors ──SEQS──▶ learner ingest handlers ──SEQS──▶ ┌─────────────┐
+                       (accounting banked HERE,         │ shard proc p │
+                        re-routed on shard death)       │  ReplayShard │
+    learner pull loop ──SAMPLE_REQ──▶                   │  (own ring,  │
+                      ◀──BATCH {.., epoch}──            │   own epoch) │
+                      ──PRIO {.., epoch}──▶             └─────────────┘
+
+- **One listening socket per shard**, speaking the existing frame
+  protocol (``fleet/transport.py`` framing, ``fleet/wire.py`` payloads on
+  the fleet's negotiated lane) with HELLO auth and heartbeat/reap on both
+  legs — a shard is a peer like any other, not a trusted side door.
+- **Two legs**: the learner's ingest handlers forward each actor's SEQS
+  batches into its shard (the accounting deltas NEVER cross — they bank
+  in the learner, so a dead shard loses only re-collectable experience,
+  at-least-once like the actor wire), and the sampler learner pulls
+  SAMPLE_REQ/BATCH and writes back PRIO over its own connection.
+- **Graceful degradation**: a dead shard zeroes its advertised ``Σp^α``
+  in the learner's shard map, so the very next quota draw renormalizes
+  over the survivors (``shard_quotas`` already weights empty shards at
+  0); ingest handlers re-route their actors to the next live shard in
+  ring order.  A dead replay node degrades sampling, never training.
+- **Epoch-fenced rejoin**: the supervisor (the ``supervisor.py`` backoff
+  ladder, ``role="shard"``) respawns a crashed shard with a BUMPED
+  ``--epoch``; the restarted incarnation comes back empty and stamps the
+  epoch into every BATCH (and checks it on every PRIO), so handles
+  sampled from the previous incarnation are ignored exactly like
+  param-version regressions — slot generations restart at zero and WOULD
+  falsely match without the fence.
+- **Chaos-drilled**: ``kill_shard`` (supervisor SIGKILL), ``stall_shard``
+  (in-process response gate — zero sheds, zero false reaps through it)
+  and ``partition_shard`` (both legs' connections dropped; data survives
+  under the SAME epoch) land in the ``--chaos-spec`` grammar
+  (``fleet/chaos.py``), making the chaos harness the tier's acceptance
+  test.
+
+``--shard-procs 0`` (the default) is the in-learner loopback of PR 10,
+retained untouched and pinned bit-identical through the CLI
+(``scripts/lib_gate.sh shard_gate``).  ``--shard-procs N`` hosts the
+``--replay-shards M`` shards in N processes (M % N == 0, contiguous
+slices; each shard keeps its own listening socket inside the process).
+
+The learner side of this module (``RemoteShard``/``RemoteShardSet``/
+``ShardProcTier``) mirrors the loopback ``ShardSet`` interface, so the
+ingest server and the sampler learner are agnostic to where replay lives
+(docs/REPLAY.md "Topology").
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from r2d2dpg_tpu.fleet import chaos as fleet_chaos
+from r2d2dpg_tpu.fleet import transport, wire
+from r2d2dpg_tpu.fleet.transport import (
+    HEADER_BYTES,
+    MAX_FRAME_BYTES,
+    READ_DEADLINE_S,
+    K_ACK,
+    K_BATCH,
+    K_BYE,
+    K_HELLO,
+    K_PRIO,
+    K_SAMPLE_REQ,
+    K_SEQS,
+    FrameError,
+    PeerDeadError,
+    hello_auth_proof,
+    pack_hello,
+    pack_obj,
+    recv_frame,
+    recv_frame_heartbeat,
+    send_frame,
+    send_frame_parts,
+    unpack_obj,
+)
+from r2d2dpg_tpu.obs import flight_event, get_registry, set_flight_identity
+from r2d2dpg_tpu.replay.arena import StagedSequences
+from r2d2dpg_tpu.replay.sharded import ReplayShard
+from r2d2dpg_tpu.utils.codes import OK, REFUSED_AUTH, REFUSED_WIRE
+
+import hmac as _hmac_mod
+
+
+class ShardUnavailableError(Exception):
+    """The shard's process is unreachable (dial refused / conn torn and
+    re-dial failed): the learner-side verdict that marks a shard DEAD and
+    renormalizes quotas over the survivors.
+
+    ``not_up`` distinguishes a shard that has NOT YET published an
+    address (startup: its process may still be importing jax) from one
+    that went away — the first SEQS of a run racing the address-file
+    publish must wait, not fire a spurious ``shard_dead``."""
+
+    def __init__(self, msg: str, *, not_up: bool = False):
+        super().__init__(msg)
+        self.not_up = not_up
+
+
+# ---------------------------------------------------------------- server
+class ShardServer:
+    """One replay shard behind one listening socket (the shard-process
+    side).  Accepts any number of authenticated connections — the
+    learner's per-actor ingest handlers (SEQS leg) and its sampler
+    (SAMPLE_REQ/BATCH/PRIO leg) — each served by a handler thread.
+
+    Protocol per connection (all payloads on the fleet's negotiated wire
+    lane; control acks are post-auth ``pack_obj`` dicts)::
+
+        HELLO {auth?, wire...}    ->  ACK {code, shard, epoch}
+        SEQS {staged}             ->  ACK {code, epoch, occupancy,
+                                           scaled_sum, priority_sum,
+                                           evictions}
+        SAMPLE_REQ {quota}        ->  BATCH {seqs, slots/gens/probs,
+                                             Σp^α, epoch}
+        PRIO {slots/gens/p, epoch}->  ACK {code, applied, stale, epoch}
+
+    Every reply passes the chaos stall gate (``ShardChaos.gate``) so a
+    ``stall_shard`` drill makes the WHOLE shard unresponsive — the
+    documented wedge both legs must wait out without sheds or reaps.
+    """
+
+    def __init__(
+        self,
+        shard: ReplayShard,
+        *,
+        address: str = "127.0.0.1:0",
+        epoch: int = 0,
+        seed: int = 0,
+        wire_config: Optional[wire.WireConfig] = None,
+        max_frame_bytes: int = MAX_FRAME_BYTES,
+        read_deadline_s: float = READ_DEADLINE_S,
+        auth_token: Optional[str] = None,
+        chaos: Optional[fleet_chaos.ShardChaos] = None,
+    ):
+        self.shard = shard
+        self.epoch = int(epoch)
+        self._request_address = address
+        self.wire_config = (wire_config or wire.WireConfig()).validate()
+        self.max_frame_bytes = max_frame_bytes
+        self.read_deadline_s = read_deadline_s
+        self.auth_token = auth_token
+        self.chaos = chaos
+        # Within-shard draws are served by THIS incarnation's stream:
+        # seeded per (seed, shard, epoch) so a restarted shard never
+        # replays its predecessor's draw sequence against a fresh ring.
+        self._rng = np.random.default_rng(
+            (int(seed), int(shard.shard_id), int(epoch))
+        )
+        self.address: Optional[str] = None
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._handlers: List[threading.Thread] = []
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_seq = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        reg = get_registry()
+        self._obs_stale_prio = reg.counter(
+            "r2d2dpg_shard_stale_epoch_prio_total",
+            "PRIO write-back frames ignored because their epoch named a "
+            "previous incarnation of this shard (the rejoin fence)",
+        )
+        self._obs_peer_dead = reg.counter(
+            "r2d2dpg_shard_peer_dead_total",
+            "shard-side connections reaped after a silent heartbeat "
+            "deadline (the peer answered neither frames nor the PING)",
+        )
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "ShardServer":
+        if self._listener is not None:
+            raise RuntimeError("shard server already started")
+        family, target = transport.parse_address(self._request_address)
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        if family == socket.AF_INET:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind(target)
+        sock.listen(32)
+        if family == socket.AF_INET:
+            host, port = sock.getsockname()[:2]
+            self.address = f"{host}:{port}"
+        else:
+            self.address = f"unix:{target}"
+        self._listener = sock
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop,
+            name=f"shard{self.shard.shard_id}-accept",
+            daemon=True,
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns.values())
+        for conn in conns:
+            # SHUT_RDWR first: close() alone does not wake a handler whose
+            # blocking recv holds a reference to the open file description
+            # (the IngestServer.drop_connection lesson).
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+        for t in list(self._handlers):
+            t.join(timeout=5)
+
+    # ----------------------------------------------------------- connection
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            if self._stop.is_set():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            transport.configure_socket(conn)
+            conn.settimeout(self.read_deadline_s)
+            with self._lock:
+                self._conn_seq += 1
+                ident = self._conn_seq
+                self._conns[ident] = conn
+            self._handlers = [t for t in self._handlers if t.is_alive()]
+            t = threading.Thread(
+                target=self._handle,
+                args=(ident, conn),
+                name=f"shard{self.shard.shard_id}-conn{ident}",
+                daemon=True,
+            )
+            self._handlers.append(t)
+            t.start()
+
+    def _gate(self) -> None:
+        if self.chaos is not None:
+            self.chaos.gate()
+
+    def _advert(self, code: str = OK) -> Dict[str, Any]:
+        """The shard's state advertisement riding every control ack: the
+        learner's quota weights (``scaled_sum`` = Σp^α), the raw priority
+        sum (the obs gauge's value), occupancy, and the cumulative ring
+        evictions — so a shard that is absorbing but not yet sampled-from
+        still reports growth to the absorb gate."""
+        s = self.shard
+        return {
+            "code": code,
+            "shard": s.shard_id,
+            "epoch": self.epoch,
+            "occupancy": s.occupancy(),
+            "scaled_sum": s.scaled_sum(),
+            "priority_sum": s.priority_sum(),
+            "evictions": s.evictions_total,
+        }
+
+    def _handle(self, ident: int, conn: socket.socket) -> None:
+        peer = "?"
+        unpacker = wire.TreeUnpacker(max_frame_bytes=self.max_frame_bytes)
+        batch_packer = wire.TreePacker(
+            self.wire_config, max_frame_bytes=self.max_frame_bytes
+        )
+        try:
+            kind, payload = recv_frame(
+                conn, max_frame_bytes=self.max_frame_bytes
+            )
+            if kind != K_HELLO:
+                raise FrameError(f"expected HELLO, got kind {kind}")
+            hello = transport.unpack_hello(payload)
+            peer = str(hello.get("actor_id", "?"))
+            if self.auth_token is not None:
+                # Same door discipline as the ingest server: the proof is
+                # checked BEFORE negotiation or any shard state is touched
+                # (a shard socket is reachable by whatever can reach the
+                # learner's, so it holds the same line).
+                want = hello_auth_proof(self.auth_token)
+                got = str(hello.get("auth", ""))
+                if not _hmac_mod.compare_digest(want, got):
+                    flight_event("shard_auth_refused", peer=peer)
+                    send_frame(
+                        conn,
+                        K_ACK,
+                        pack_obj(  # wire-lint: control
+                            {"code": REFUSED_AUTH, "epoch": self.epoch}
+                        ),
+                    )
+                    return
+            mismatch = wire.check_negotiation(hello, self.wire_config)
+            if mismatch is not None:
+                flight_event(
+                    "shard_wire_refused", peer=peer, reason=mismatch
+                )
+                send_frame(
+                    conn,
+                    K_ACK,
+                    pack_obj(  # wire-lint: control
+                        {
+                            "code": REFUSED_WIRE,
+                            "epoch": self.epoch,
+                            "reason": mismatch,
+                        }
+                    ),
+                )
+                return
+            send_frame(
+                conn,
+                K_ACK,
+                pack_obj(self._advert()),  # wire-lint: control
+            )
+            while not self._stop.is_set():
+                kind, payload = recv_frame_heartbeat(
+                    conn, max_frame_bytes=self.max_frame_bytes
+                )
+                if kind == K_BYE:
+                    return
+                if kind == K_SEQS:
+                    msg = unpacker.unpack(payload)
+                    staged: StagedSequences = msg["staged"]
+                    self.shard.add(staged.seq, staged.priorities)
+                    if self.chaos is not None:
+                        # The stall clock: absorbed SEQS frames (any
+                        # connection); arming happens before the gate so
+                        # the arming frame's OWN ack is already stalled.
+                        self.chaos.on_seqs_frame()
+                    self._gate()
+                    send_frame(
+                        conn,
+                        K_ACK,
+                        pack_obj(self._advert()),  # wire-lint: control
+                    )
+                elif kind == K_SAMPLE_REQ:
+                    req = wire.unpack_sample_req(unpacker.unpack(payload))
+                    if req["shard"] != self.shard.shard_id:
+                        raise FrameError(
+                            f"SAMPLE_REQ for shard {req['shard']} on shard "
+                            f"{self.shard.shard_id}'s socket"
+                        )
+                    try:
+                        s = self.shard.sample(req["quota"], self._rng)
+                    except ValueError:
+                        # EMPTY shard: a learner whose quota weights are a
+                        # stale advert of a dead predecessor can
+                        # legitimately route draws at a freshly-restarted
+                        # ring.  Answer honestly with an empty-marked ack
+                        # (the advert zeroes its quota weight for the next
+                        # draw) — tearing the connection here would read
+                        # as a DEAD process and fire a spurious
+                        # shard_dead/renorm on a healthy shard.
+                        self._gate()
+                        send_frame(
+                            conn,
+                            K_ACK,
+                            pack_obj(  # wire-lint: control
+                                {**self._advert(), "empty": True}
+                            ),
+                        )
+                        continue
+                    self._gate()
+                    send_frame_parts(
+                        conn,
+                        K_BATCH,
+                        wire.pack_shard_batch(
+                            batch_packer,
+                            req_id=req["req_id"],
+                            shard=self.shard.shard_id,
+                            staged=StagedSequences(seq=s.seq, priorities=None),
+                            slots=s.slots,
+                            gens=s.gens,
+                            probs=s.probs,
+                            priority_sum=self.shard.scaled_sum(),
+                            occupancy=self.shard.occupancy(),
+                            epoch=self.epoch,
+                        ),
+                        max_frame_bytes=self.max_frame_bytes,
+                    )
+                elif kind == K_PRIO:
+                    upd = wire.unpack_prio_update(unpacker.unpack(payload))
+                    if upd["shard"] != self.shard.shard_id:
+                        raise FrameError(
+                            f"PRIO for shard {upd['shard']} on shard "
+                            f"{self.shard.shard_id}'s socket"
+                        )
+                    stale = upd["epoch"] != self.epoch
+                    if stale:
+                        # The rejoin fence: this verdict is about a ring a
+                        # previous incarnation owned — slot generations
+                        # restarted at zero, so applying it would clobber
+                        # FRESH sequences' priorities with stale TD errors.
+                        flight_event(
+                            "stale_epoch_prio_ignored",
+                            shard=self.shard.shard_id,
+                            got_epoch=upd["epoch"],
+                            epoch=self.epoch,
+                            entries=int(upd["slots"].shape[0]),
+                        )
+                        self._obs_stale_prio.inc()
+                        applied = 0
+                    else:
+                        applied = self.shard.update_priorities(
+                            upd["slots"], upd["gens"], upd["priorities"]
+                        )
+                    self._gate()
+                    send_frame(
+                        conn,
+                        K_ACK,
+                        pack_obj(  # wire-lint: control
+                            {
+                                "code": OK,
+                                "applied": int(applied),
+                                "stale": bool(stale),
+                                "epoch": self.epoch,
+                            }
+                        ),
+                    )
+                else:
+                    raise FrameError(f"unexpected frame kind {kind}")
+        except PeerDeadError as e:
+            if not self._stop.is_set():
+                flight_event(
+                    "shard_peer_dead",
+                    shard=self.shard.shard_id,
+                    peer=peer,
+                    error=str(e),
+                )
+                self._obs_peer_dead.inc()
+        except (FrameError, OSError, ValueError) as e:
+            if not self._stop.is_set():
+                flight_event(
+                    "shard_conn_error",
+                    shard=self.shard.shard_id,
+                    peer=peer,
+                    error=f"{type(e).__name__}: {e}",
+                )
+        finally:
+            with self._lock:
+                self._conns.pop(ident, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+# ------------------------------------------------------- learner-side client
+class RemoteShard:
+    """Learner-side client for ONE out-of-process shard: two connections
+    (the ingest handlers' shared SEQS leg and the sampler's
+    SAMPLE_REQ/BATCH/PRIO leg, each behind its own lock), the epoch
+    learned at HELLO, and the shard's last advertisement.
+
+    A torn established connection is re-dialed ONCE inline (a partition
+    or reaped conn heals here, with a fresh schema cache on both sides);
+    a refused dial is the process-down verdict —
+    ``ShardUnavailableError``, and the owning ``RemoteShardSet`` marks
+    the shard dead."""
+
+    def __init__(
+        self,
+        shard_id: int,
+        address_fn: Callable[[], Optional[str]],
+        *,
+        wire_config: wire.WireConfig,
+        auth_token: Optional[str],
+        max_frame_bytes: int,
+        read_deadline_s: float,
+        on_bytes: Optional[Callable[[str, int], None]] = None,
+    ):
+        self.shard_id = int(shard_id)
+        self.address_fn = address_fn
+        self.wire_config = wire_config
+        self.auth_token = auth_token
+        self.max_frame_bytes = max_frame_bytes
+        self.read_deadline_s = read_deadline_s
+        self._on_bytes = on_bytes or (lambda leg, n: None)
+        self.epoch = 0
+        self.alive = True  # optimistic until a dial fails
+        self.ever_connected = False  # first HELLO flips it (startup gate)
+        # Last advertisement (SEQS acks + BATCH frames refresh it): the
+        # learner's quota weights and absorb-gate occupancy live here —
+        # a dead shard's advert is zeroed by the owning set.
+        self.scaled_sum = 0.0
+        self.priority_sum = 0.0
+        self.occupancy = 0
+        # Evictions are MONOTONE across incarnations: ``evictions`` is the
+        # live incarnation's advertised count (resets to zero with its
+        # ring), ``evictions_prior`` banks the dead incarnations' totals
+        # at rejoin — the tier-wide stat must never decrease through a
+        # kill_shard drill.
+        self.evictions = 0
+        self.evictions_prior = 0
+        self._on_evictions: Callable[[int], None] = lambda n: None
+        self._legs: Dict[str, Optional[socket.socket]] = {
+            "ingest": None, "sample": None,
+        }
+        self._packers: Dict[str, Optional[wire.TreePacker]] = {
+            "ingest": None, "sample": None,
+        }
+        self._unpackers: Dict[str, Optional[wire.TreeUnpacker]] = {
+            "ingest": None, "sample": None,
+        }
+        self._locks = {"ingest": threading.Lock(), "sample": threading.Lock()}
+
+    # ---------------------------------------------------------------- conns
+    def _dial(self, leg: str) -> None:
+        addr = self.address_fn()
+        if addr is None:
+            raise ShardUnavailableError(
+                f"shard {self.shard_id}: no address published yet",
+                not_up=not self.ever_connected,
+            )
+        try:
+            sock = transport.connect(
+                addr, timeout=5.0, read_deadline_s=self.read_deadline_s
+            )
+        except OSError as e:
+            raise ShardUnavailableError(
+                f"shard {self.shard_id} at {addr}: {e}"
+            )
+        try:
+            hello = {
+                "actor_id": f"learner-{leg}",
+                "role": leg,
+                **wire.negotiation_fields(self.wire_config),
+            }
+            if self.auth_token is not None:
+                hello["auth"] = hello_auth_proof(self.auth_token)
+            n = send_frame(
+                sock,
+                K_HELLO,
+                pack_hello(hello),
+                max_frame_bytes=self.max_frame_bytes,
+            )
+            self._on_bytes(leg, n)
+            kind, payload = recv_frame(
+                sock, max_frame_bytes=self.max_frame_bytes
+            )
+            self._on_bytes(leg, HEADER_BYTES + len(payload))
+            ack = unpack_obj(payload)  # wire-lint: control
+            if ack.get("code") != OK:
+                # The learner spawned this shard with its own lane/token,
+                # so a refusal is deterministic misconfiguration — raise
+                # loudly, never retry into a refusal loop.
+                raise RuntimeError(
+                    f"shard {self.shard_id} refused HELLO: {ack.get('code')}"
+                    f" ({ack.get('reason')})"
+                )
+            self._apply_advert(ack)
+            self.epoch = int(ack.get("epoch", 0))
+        except (FrameError, OSError) as e:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise ShardUnavailableError(
+                f"shard {self.shard_id} HELLO failed: {e}"
+            )
+        self._legs[leg] = sock
+        self.ever_connected = True
+        # Wire state lives and dies with the socket — a reconnect gets
+        # fresh schema caches on both sides (the server's unpacker is
+        # per-connection too).
+        self._packers[leg] = wire.TreePacker(
+            self.wire_config, max_frame_bytes=self.max_frame_bytes
+        )
+        self._unpackers[leg] = wire.TreeUnpacker(
+            max_frame_bytes=self.max_frame_bytes
+        )
+
+    def _drop_leg(self, leg: str) -> None:
+        sock = self._legs[leg]
+        self._legs[leg] = None
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def drop_connections(self) -> int:
+        """Abruptly close both legs (the ``partition_shard`` chaos
+        boundary).  Returns how many live legs were dropped."""
+        dropped = 0
+        for leg in ("ingest", "sample"):
+            with self._locks[leg]:
+                if self._legs[leg] is not None:
+                    dropped += 1
+                self._drop_leg(leg)
+        return dropped
+
+    def close(self) -> None:
+        for leg in ("ingest", "sample"):
+            with self._locks[leg]:
+                sock = self._legs[leg]
+                if sock is not None:
+                    try:
+                        send_frame(sock, K_BYE, b"")  # wire-lint: control
+                    except OSError:
+                        pass
+                self._drop_leg(leg)
+
+    def _apply_advert(self, ack: Dict[str, Any]) -> None:
+        self.scaled_sum = float(ack.get("scaled_sum", self.scaled_sum))
+        self.priority_sum = float(ack.get("priority_sum", self.priority_sum))
+        self.occupancy = int(ack.get("occupancy", self.occupancy))
+        ev = int(ack.get("evictions", self.evictions))
+        if ev > self.evictions:
+            # Within one incarnation the advert is monotone; the delta
+            # feeds the learner-side obs counter (the loopback registers
+            # the same one via evict_cb — one dashboard either way).
+            self._on_evictions(ev - self.evictions)
+            self.evictions = ev
+
+    def _exchange(self, leg: str, do_exchange):
+        """Run one send/recv exchange on a leg, re-dialing a torn
+        connection once (at-least-once on the SEQS leg: a duplicate add
+        is re-collectable experience, the documented posture).  Raises
+        ``ShardUnavailableError`` when the process is unreachable."""
+        with self._locks[leg]:
+            for attempt in (0, 1):
+                if self._legs[leg] is None:
+                    self._dial(leg)
+                try:
+                    return do_exchange(
+                        self._legs[leg],
+                        self._packers[leg],
+                        self._unpackers[leg],
+                    )
+                except (FrameError, OSError) as e:
+                    self._drop_leg(leg)
+                    if attempt == 1 or isinstance(e, PeerDeadError):
+                        raise ShardUnavailableError(
+                            f"shard {self.shard_id} {leg} leg: "
+                            f"{type(e).__name__}: {e}"
+                        )
+
+    # ----------------------------------------------------------------- legs
+    def forward_seqs(self, staged: StagedSequences) -> Dict[str, Any]:
+        """SEQS leg: forward one staged batch, return the shard's ack
+        advertisement (already applied)."""
+
+        def do(sock, packer, unpacker):
+            n = send_frame_parts(
+                sock,
+                K_SEQS,
+                packer.pack({"staged": staged}),
+                max_frame_bytes=self.max_frame_bytes,
+            )
+            self._on_bytes("ingest", n)
+            kind, payload = recv_frame_heartbeat(
+                sock, max_frame_bytes=self.max_frame_bytes
+            )
+            self._on_bytes("ingest", HEADER_BYTES + len(payload))
+            if kind != K_ACK:
+                raise FrameError(f"expected ACK, got kind {kind}")
+            ack = unpack_obj(payload)  # wire-lint: control
+            self._apply_advert(ack)
+            self.epoch = int(ack.get("epoch", self.epoch))
+            return ack
+
+        return self._exchange("ingest", do)
+
+    def sample(self, quota: int, req_id: int) -> Optional[Dict[str, Any]]:
+        """Sampler leg: one SAMPLE_REQ/BATCH exchange.  The BATCH's epoch
+        must match the connection's HELLO epoch — a mismatch is a stale
+        in-flight batch from a previous incarnation and is dropped with a
+        flight event (the caller redistributes the quota).  Returns
+        ``None`` for an EMPTY shard (the server answers with an
+        empty-marked advert ack instead of a BATCH — a stale quota weight
+        routed draws at a live-but-fresh ring; the applied advert zeroes
+        its weight for the caller's redistribution)."""
+
+        def do(sock, packer, unpacker):
+            n = send_frame_parts(
+                sock,
+                K_SAMPLE_REQ,
+                wire.pack_sample_req(
+                    packer,
+                    req_id=req_id,
+                    shard=self.shard_id,
+                    quota=int(quota),
+                ),
+                max_frame_bytes=self.max_frame_bytes,
+            )
+            self._on_bytes("sample", n)
+            kind, payload = recv_frame_heartbeat(
+                sock, max_frame_bytes=self.max_frame_bytes
+            )
+            self._on_bytes("sample", HEADER_BYTES + len(payload))
+            if kind == K_ACK:
+                ack = unpack_obj(payload)  # wire-lint: control
+                if ack.get("empty"):
+                    self._apply_advert(ack)
+                    return None
+                raise FrameError("unexpected non-empty ACK to SAMPLE_REQ")
+            if kind != K_BATCH:
+                raise FrameError(f"expected BATCH, got kind {kind}")
+            resp = wire.unpack_shard_batch(unpacker.unpack(payload))
+            if resp["shard"] != self.shard_id:
+                raise FrameError(
+                    f"BATCH for shard {resp['shard']} on shard "
+                    f"{self.shard_id}'s leg"
+                )
+            if resp["epoch"] != self.epoch:
+                flight_event(
+                    "stale_epoch_batch_ignored",
+                    shard=self.shard_id,
+                    got_epoch=resp["epoch"],
+                    epoch=self.epoch,
+                )
+                raise FrameError(
+                    f"BATCH epoch {resp['epoch']} != connection epoch "
+                    f"{self.epoch}"
+                )
+            self.scaled_sum = float(resp["priority_sum"])
+            self.occupancy = int(resp["occupancy"])
+            return resp
+
+        return self._exchange("sample", do)
+
+    def write_back(
+        self,
+        slots: np.ndarray,
+        gens: np.ndarray,
+        priorities: np.ndarray,
+        *,
+        epoch: int,
+    ) -> Dict[str, Any]:
+        """Sampler leg: one PRIO/ACK exchange (the shard applies only
+        matching (epoch, slot, generation) handles)."""
+
+        def do(sock, packer, unpacker):
+            n = send_frame_parts(
+                sock,
+                K_PRIO,
+                wire.pack_prio_update(
+                    packer,
+                    shard=self.shard_id,
+                    slots=slots,
+                    gens=gens,
+                    priorities=priorities,
+                    epoch=epoch,
+                ),
+                max_frame_bytes=self.max_frame_bytes,
+            )
+            self._on_bytes("sample", n)
+            kind, payload = recv_frame_heartbeat(
+                sock, max_frame_bytes=self.max_frame_bytes
+            )
+            self._on_bytes("sample", HEADER_BYTES + len(payload))
+            if kind != K_ACK:
+                raise FrameError(f"expected ACK, got kind {kind}")
+            return unpack_obj(payload)  # wire-lint: control
+
+        return self._exchange("sample", do)
+
+
+class RemoteShardSet:
+    """The out-of-process tier behind the loopback ``ShardSet``'s exact
+    interface (``route``/``add``/``pop_stats``/``occupancy_total``/
+    ``scaled_sums``/``evictions_total``), plus the liveness machinery the
+    standalone tier needs: a shard map with per-shard alive/epoch state,
+    deterministic re-routing of dead shards' actor traffic to the next
+    live shard in ring order, advertisement-backed quota weights (dead
+    shards advertise 0, so ``shard_quotas`` renormalizes over survivors
+    with no special case), rate-limited epoch-fenced rejoin, and the
+    ``partition_shard`` chaos boundary.
+
+    Accounting deltas bank HERE (the learner process), exactly like the
+    loopback set: a dead shard loses only re-collectable experience,
+    never step/episode sums — the at-least-once contract the actor wire
+    already guarantees, carried one hop further."""
+
+    remote = True  # SamplerLearner dispatches its pull path on this
+
+    def __init__(
+        self,
+        num_shards: int,
+        address_fn: Callable[[int], Optional[str]],
+        *,
+        wire_config: wire.WireConfig,
+        auth_token: Optional[str] = None,
+        max_frame_bytes: int = MAX_FRAME_BYTES,
+        read_deadline_s: float = READ_DEADLINE_S,
+        rejoin_interval_s: float = 0.5,
+    ):
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self._stop = threading.Event()
+        self.rejoin_interval_s = rejoin_interval_s
+        self._rejoin_last: Dict[int, float] = {}
+        self._rejoin_refused: set = set()  # deterministic refusals: give up
+        self._stats_lock = threading.Lock()
+        self._stats = {
+            "env_steps_delta": 0.0, "ep_return_sum": 0.0, "ep_count": 0.0,
+        }
+        # Liveness transitions and the byte/death counters are touched by
+        # N ingest-handler threads plus the sampler thread: one lock keeps
+        # the check-then-act in _mark_dead single-shot (no duplicate
+        # death/renorm events) and the += counters lossless.
+        self._live_lock = threading.Lock()
+        # One rejoiner at a time: the sampler thread and (tier-down) ingest
+        # handlers all call maybe_rejoin; concurrent passes would double-
+        # record one physical rejoin (events + counters + advert zeroing).
+        self._rejoin_lock = threading.Lock()
+        self.sample_bytes_total = 0
+        self.forward_bytes_total = 0
+        self.deaths_total = 0
+        self.rejoins_total = 0
+        self._on_sample_bytes: Callable[[int], None] = lambda n: None
+        reg = get_registry()
+        self._obs_deaths = reg.counter(
+            "r2d2dpg_shard_deaths_total",
+            "shard processes detected dead by the learner (dial refused "
+            "after a torn connection); each one triggers quota "
+            "renormalization over the survivors",
+            labelnames=("shard",),
+        )
+        self._obs_rejoins = reg.counter(
+            "r2d2dpg_shard_rejoins_total",
+            "dead shards that rejoined under a bumped epoch (supervisor "
+            "restart + fresh HELLO)",
+            labelnames=("shard",),
+        )
+        self._obs_renorms = reg.counter(
+            "r2d2dpg_shard_quota_renorms_total",
+            "quota renormalizations over surviving shards (one per shard "
+            "death: the dead shard's advertised sum is zeroed, so every "
+            "subsequent quota draw redistributes its share)",
+        )
+        # Same gauge names as the loopback set: where replay lives is
+        # deployment, not semantics — one dashboard either way.
+        psum = reg.gauge(
+            "r2d2dpg_replay_shard_priority_sum",
+            "raw priority sum of one replay shard (the quota weight is "
+            "sum p^alpha — ReplayShard.scaled_sum)",
+            labelnames=("shard",),
+        )
+        occ = reg.gauge(
+            "r2d2dpg_replay_shard_occupancy",
+            "filled slots of one replay shard",
+            labelnames=("shard",),
+        )
+        evict = reg.counter(
+            "r2d2dpg_replay_shard_evictions_total",
+            "filled replay-shard slots FIFO-overwritten by the ring "
+            "(re-collectable experience recycled before it was sampled)",
+            labelnames=("shard",),
+        )
+        self.shards = [
+            RemoteShard(
+                i,
+                (lambda sid=i: address_fn(sid)),
+                wire_config=wire_config,
+                auth_token=auth_token,
+                max_frame_bytes=max_frame_bytes,
+                read_deadline_s=read_deadline_s,
+                on_bytes=self._count_bytes,
+            )
+            for i in range(num_shards)
+        ]
+        for i, s in enumerate(self.shards):
+            psum.labels(shard=str(i)).set_fn(
+                lambda sh=s: sh.priority_sum if sh.alive else 0.0
+            )
+            occ.labels(shard=str(i)).set_fn(
+                lambda sh=s: float(sh.occupancy) if sh.alive else 0.0
+            )
+            # Advert deltas feed the same counter the loopback bumps via
+            # evict_cb: the eviction-visibility satellite holds in BOTH
+            # deployments (a shard process's own registry has no scraper).
+            s._on_evictions = evict.labels(shard=str(i)).inc
+
+    # ------------------------------------------------------------- plumbing
+    def _count_bytes(self, leg: str, n: int) -> None:
+        if leg == "sample":
+            with self._live_lock:
+                self.sample_bytes_total += n
+            self._on_sample_bytes(n)
+        else:
+            with self._live_lock:
+                self.forward_bytes_total += n
+
+    def bind_sample_bytes(self, fn: Callable[[int], None]) -> None:
+        """The sampler learner's byte counter rides every sampler-leg
+        frame (REQ/BATCH/PRIO + acks, headers included) — the honest
+        cross-process cost of the sampling boundary."""
+        self._on_sample_bytes = fn
+
+    def close(self) -> None:
+        self._stop.set()
+        for s in self.shards:
+            s.close()
+
+    # ------------------------------------------------------------- liveness
+    def _mark_dead(self, shard_id: int, error: str) -> None:
+        s = self.shards[shard_id]
+        with self._live_lock:
+            if not s.alive:
+                return  # another thread already recorded this death
+            s.alive = False
+            self.deaths_total += 1
+        s.drop_connections()
+        self._obs_deaths.labels(shard=str(shard_id)).inc()
+        flight_event("shard_dead", shard=shard_id, error=error)
+        # The renormalization moment, recorded HERE deterministically
+        # (whichever leg detects the death first): the dead shard's
+        # advertised weight is zero from this instant, so the very next
+        # quota draw — at latest, the next phase — redistributes its
+        # share over the survivors.
+        self._obs_renorms.inc()
+        flight_event(
+            "shard_quota_renorm",
+            shard=shard_id,
+            survivors=[x.shard_id for x in self.shards if x.alive],
+        )
+
+    def maybe_rejoin(self) -> None:
+        """Attempt (rate-limited) reconnection of dead shards: a restarted
+        incarnation publishes a fresh address (the tier's address file)
+        and answers HELLO with its bumped epoch — from that moment it is
+        live in the map, its empty ring advertises 0 until traffic
+        refills it, and handlers route its actors home again."""
+        if not self._rejoin_lock.acquire(blocking=False):
+            return  # another thread is already rejoining this pass
+        try:
+            self._maybe_rejoin_locked()
+        finally:
+            self._rejoin_lock.release()
+
+    def _maybe_rejoin_locked(self) -> None:
+        now = time.monotonic()
+        for s in self.shards:
+            if s.alive or s.shard_id in self._rejoin_refused:
+                continue
+            if now - self._rejoin_last.get(s.shard_id, 0.0) < (
+                self.rejoin_interval_s
+            ):
+                continue
+            self._rejoin_last[s.shard_id] = now
+            old_epoch = s.epoch
+            try:
+                with s._locks["sample"]:
+                    if s._legs["sample"] is None:  # raced heal: keep it
+                        s._dial("sample")
+            except ShardUnavailableError:
+                continue
+            except RuntimeError as e:
+                # A refused HELLO (auth/wire mismatch) is deterministic
+                # misconfiguration: every retry would be refused again
+                # within milliseconds — give this shard's rejoin up
+                # LOUDLY instead of spinning into the starvation timeout
+                # with a misleading "is the tier down?" verdict (the
+                # supervisor's terminal-exit contract, learner-side).
+                self._rejoin_refused.add(s.shard_id)
+                flight_event(
+                    "shard_rejoin_refused", shard=s.shard_id, error=str(e)
+                )
+                continue
+            if s.epoch != old_epoch:
+                # A restarted incarnation comes back EMPTY: zero the
+                # stale advertisement now rather than waiting for its
+                # first ack — quota weights must never credit the dead
+                # ring's sums to the fresh one.  Evictions instead BANK
+                # (the tier-wide count is monotone; the new ring's advert
+                # restarts at zero).
+                s.scaled_sum = 0.0
+                s.priority_sum = 0.0
+                s.occupancy = 0
+                s.evictions_prior += s.evictions
+                s.evictions = 0
+            # else: SAME incarnation — a spurious death verdict or a
+            # partition that read as one.  Its ring (and eviction count)
+            # is intact, and the re-dial's HELLO ack already refreshed
+            # the advert; banking here would double-count evictions and
+            # starve a data-holding shard of quota.
+            with self._live_lock:
+                s.alive = True
+            self.rejoins_total += 1
+            self._obs_rejoins.labels(shard=str(s.shard_id)).inc()
+            flight_event(
+                "shard_rejoin",
+                shard=s.shard_id,
+                epoch=s.epoch,
+                previous_epoch=old_epoch,
+            )
+
+    def partition(self, shard_id: int) -> bool:
+        """The ``partition_shard`` chaos boundary: drop BOTH legs'
+        connections to one shard (a network partition, not a restart —
+        the shard's data and epoch survive; both legs reconnect lazily).
+        Returns True when at least one live connection was dropped."""
+        return self.shards[int(shard_id)].drop_connections() > 0
+
+    # --------------------------------------------------- ShardSet interface
+    @property
+    def num_shards(self) -> int:
+        return len(self.shards)
+
+    def route(self, actor_id: Any) -> int:
+        """Liveness-aware routing: the actor's home shard
+        (``shard_for_actor``) when alive, else the next live shard in
+        ring order — deterministic, so every handler agrees, and the
+        actor lands back home the moment its shard rejoins."""
+        from r2d2dpg_tpu.fleet.sampler import shard_for_actor
+
+        home = shard_for_actor(actor_id, len(self.shards))
+        for off in range(len(self.shards)):
+            sid = (home + off) % len(self.shards)
+            if self.shards[sid].alive:
+                return sid
+        return home  # all dead: add() waits for a rejoin
+
+    def add(self, shard_id: int, msg: Dict[str, Any]) -> int:
+        """One SEQS message into the tier (ingest-handler side): bank the
+        accounting deltas FIRST (they must survive any shard outcome),
+        then forward the experience to the routed shard — re-routing to
+        survivors on failure, waiting out a fully-dead tier (the actor's
+        ack wait is the backpressure) until stop.  Returns B."""
+        staged: StagedSequences = msg["staged"]
+        n = int(np.shape(staged.seq.reward)[0])
+        with self._stats_lock:
+            for k in self._stats:
+                self._stats[k] += float(msg.get(k, 0.0))
+        target = int(shard_id)
+        while not self._stop.is_set():
+            if not self.shards[target].alive:
+                target = self.route(msg.get("actor_id", target))
+            if not self.shards[target].alive:
+                # Whole tier down: wait for the supervisor's restart (the
+                # blocked handler backpressures its actor, which is the
+                # documented degradation — accounting is already banked).
+                self.maybe_rejoin()
+                time.sleep(0.1)
+                continue
+            try:
+                self.shards[target].forward_seqs(staged)
+                return n
+            except ShardUnavailableError as e:
+                if e.not_up:
+                    # Startup race: the shard process has not published
+                    # its address yet (it may still be importing jax).
+                    # That is WAITING territory, not a death — a spurious
+                    # shard_dead here would fire a renorm for a shard
+                    # that was never up and poison the recovery metrics.
+                    time.sleep(0.05)
+                    continue
+                self._mark_dead(target, str(e))
+        return n  # stopping: the run is over, experience is droppable
+
+    def pop_stats(self) -> Dict[str, float]:
+        with self._stats_lock:
+            out = dict(self._stats)
+            for k in self._stats:
+                self._stats[k] = 0.0
+        return out
+
+    def occupancy_total(self) -> int:
+        return sum(s.occupancy for s in self.shards if s.alive)
+
+    def scaled_sums(self) -> np.ndarray:
+        """Advertised quota weights; dead shards weigh 0, which is the
+        whole renormalization story — ``shard_quotas`` already draws a
+        valid multinomial over any nonnegative weights with a positive
+        sum, so the next phase's draws land on survivors with no special
+        case (tests/test_replay.py pins the degraded-subset math)."""
+        return np.asarray(
+            [s.scaled_sum if s.alive else 0.0 for s in self.shards],
+            np.float64,
+        )
+
+    def evictions_total(self) -> int:
+        # prior (dead incarnations, banked at rejoin) + live advert:
+        # monotone through kill_shard drills.
+        return sum(s.evictions_prior + s.evictions for s in self.shards)
+
+
+# ---------------------------------------------------------------- the tier
+class ShardProcTier:
+    """Learner-side owner of the standalone shard tier (``--shard-procs
+    N``): the supervisor (``supervisor.py``'s backoff/terminal-exit
+    ladder, ``role="shard"``), the per-process address files, the
+    per-incarnation epoch counter, and the ``RemoteShardSet`` the ingest
+    server and sampler learner plug into.
+
+    M shards are hosted in N processes (M % N == 0) as contiguous
+    slices; each shard keeps its own listening socket inside its
+    process.  Epochs are assigned at SPAWN (incarnation count per
+    process slot) and reach the shard on argv — no coordination: the
+    learner learns each incarnation's epoch from its HELLO ack."""
+
+    def __init__(
+        self,
+        *,
+        num_shards: int,
+        num_procs: int,
+        capacity_per_shard: int,
+        alpha: float,
+        prioritized: bool,
+        dirpath: str,
+        seed: int = 0,
+        wire_config: Optional[wire.WireConfig] = None,
+        auth_token: Optional[str] = None,
+        max_frame_bytes: int = MAX_FRAME_BYTES,
+        heartbeat_s: float = READ_DEADLINE_S,
+        chaos_spec: Optional[str] = None,
+        flight_dir: Optional[str] = None,
+        supervisor_config=None,
+    ):
+        if num_procs < 1:
+            raise ValueError("num_procs must be >= 1")
+        if num_shards % num_procs:
+            raise ValueError(
+                f"{num_shards} shards not divisible by {num_procs} shard "
+                f"processes (contiguous equal slices)"
+            )
+        self.num_shards = num_shards
+        self.num_procs = num_procs
+        self.capacity_per_shard = capacity_per_shard
+        self.alpha = alpha
+        self.prioritized = prioritized
+        self.dirpath = os.path.abspath(dirpath)
+        self.seed = seed
+        self.wire_config = (wire_config or wire.WireConfig()).validate()
+        self.auth_token = auth_token
+        self.max_frame_bytes = max_frame_bytes
+        self.heartbeat_s = heartbeat_s
+        self.chaos_spec = chaos_spec
+        self.flight_dir = flight_dir
+        self._epochs: Dict[int, int] = {}
+        self._sup_config = supervisor_config
+        self.supervisor = None
+        os.makedirs(self.dirpath, exist_ok=True)
+        self.shard_set = RemoteShardSet(
+            num_shards,
+            self._address_of,
+            wire_config=self.wire_config,
+            auth_token=auth_token,
+            max_frame_bytes=max_frame_bytes,
+            read_deadline_s=heartbeat_s,
+        )
+
+    # ------------------------------------------------------------ addresses
+    def _addr_path(self, proc_index: int) -> str:
+        return os.path.join(self.dirpath, f"shard_proc{proc_index}.addr")
+
+    def _address_of(self, shard_id: int) -> Optional[str]:
+        """Resolve a shard's CURRENT address from its process's address
+        file (atomically rewritten by every incarnation — a restarted
+        process publishes its fresh ephemeral ports there)."""
+        per = self.num_shards // self.num_procs
+        path = self._addr_path(shard_id // per)
+        try:
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) == 2 and parts[0] == str(shard_id):
+                        return parts[1]
+        except OSError:
+            return None
+        return None
+
+    # ------------------------------------------------------------ lifecycle
+    def _argv(self, proc_index: int) -> List[str]:
+        # Epoch = incarnation count for this slot: argv_fn runs exactly
+        # once per spawn, so the counter IS the fence the restarted shard
+        # stamps into its BATCH/PRIO traffic.
+        self._epochs[proc_index] = self._epochs.get(proc_index, 0) + 1
+        per = self.num_shards // self.num_procs
+        ids = ",".join(
+            str(i) for i in range(proc_index * per, (proc_index + 1) * per)
+        )
+        argv = [
+            sys.executable,
+            "-m",
+            "r2d2dpg_tpu.fleet.shard",
+            "--shard-ids", ids,
+            "--capacity", str(self.capacity_per_shard),
+            "--alpha", str(self.alpha),
+            "--prioritized", "1" if self.prioritized else "0",
+            "--epoch", str(self._epochs[proc_index]),
+            "--seed", str(self.seed),
+            "--address-file", self._addr_path(proc_index),
+            "--wire", self.wire_config.encoding,
+            "--compress", self.wire_config.compress,
+            "--max-frame-bytes", str(self.max_frame_bytes),
+            "--read-deadline", str(self.heartbeat_s),
+            "--num-shard-procs", str(self.num_procs),
+            "--proc-index", str(proc_index),
+        ]
+        if self.chaos_spec:
+            argv += ["--chaos-spec", self.chaos_spec]
+        if self.flight_dir:
+            argv += [
+                "--flight-path",
+                os.path.join(
+                    self.flight_dir, f"flight_shard{proc_index}.jsonl"
+                ),
+            ]
+        return argv
+
+    def start(self) -> "ShardProcTier":
+        from r2d2dpg_tpu.fleet.supervisor import (
+            ActorSupervisor,
+            SupervisorConfig,
+        )
+
+        env = None
+        if self.auth_token:
+            # Via the environment, never argv (the actor-spawner rule).
+            env = dict(os.environ)
+            env["R2D2DPG_FLEET_TOKEN"] = self.auth_token
+        log_fn = None
+        if self.flight_dir:
+            log_fn = lambda i: os.path.join(  # noqa: E731
+                self.flight_dir, f"shard{i}.log"
+            )
+        self.supervisor = ActorSupervisor(
+            self._argv,
+            self.num_procs,
+            role="shard",
+            # Events carry the PROCESS index under "shard_proc" — never
+            # "shard", which is the shard-ID unit shard_dead/shard_rejoin
+            # use (one proc hosts M/N shards; the units must not conflate
+            # in a flight merge).
+            id_field="shard_proc",
+            env=env,
+            log_path_fn=log_fn,
+            config=self._sup_config or SupervisorConfig(),
+        )
+        self.supervisor.start()
+        return self
+
+    def stop(self) -> None:
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        self.shard_set.close()
+
+    def kill_proc(self, proc_index: int) -> bool:
+        """The ``kill_shard`` chaos boundary (supervisor SIGKILL); returns
+        whether a kill was actually delivered (a mid-backoff corpse stays
+        a pending drill — the ChaosEngine contract)."""
+        if self.supervisor is None:
+            return False
+        return self.supervisor.kill_actor(proc_index)
+
+    @property
+    def restarts_total(self) -> int:
+        return 0 if self.supervisor is None else self.supervisor.restarts_total
+
+
+# --------------------------------------------------------------------- CLI
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="python -m r2d2dpg_tpu.fleet.shard", description=__doc__
+    )
+    p.add_argument("--shard-ids", required=True,
+                   help="comma-separated shard ids this process hosts "
+                   "(one listening socket per shard)")
+    p.add_argument("--capacity", type=int, required=True,
+                   help="ring capacity per shard")
+    p.add_argument("--alpha", type=float, default=0.6)
+    p.add_argument("--prioritized", type=int, default=1, choices=[0, 1])
+    p.add_argument("--epoch", type=int, default=1,
+                   help="this incarnation's epoch fence (the spawner bumps "
+                   "it per restart; stamped into every BATCH, checked on "
+                   "every PRIO)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--bind", default="127.0.0.1:0",
+                   help="listen address per shard ('host:0' = one "
+                   "ephemeral port per shard, published via "
+                   "--address-file)")
+    p.add_argument("--address-file", default=None,
+                   help="publish '<shard_id> <host:port>' lines here "
+                   "(atomic rewrite) once every listener is bound — the "
+                   "learner's shard map polls it across restarts")
+    p.add_argument("--wire", default="f32", choices=list(wire.ENCODINGS))
+    p.add_argument("--compress", default="none",
+                   choices=list(wire.COMPRESSIONS))
+    p.add_argument("--max-frame-bytes", type=int, default=MAX_FRAME_BYTES)
+    p.add_argument("--read-deadline", type=float, default=READ_DEADLINE_S)
+    p.add_argument("--fleet-token", default=None,
+                   help="shared HELLO secret; defaults to "
+                   "$R2D2DPG_FLEET_TOKEN (the spawner passes it via the "
+                   "environment, never argv)")
+    p.add_argument("--chaos-spec", default=None,
+                   help="seeded chaos schedule; this process fires the "
+                   "stall_shard faults that target its --proc-index")
+    p.add_argument("--num-shard-procs", type=int, default=1)
+    p.add_argument("--proc-index", type=int, default=0)
+    p.add_argument("--flight-path", default=None,
+                   help="dump this process's flight ring here on exit")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    shard_ids = [int(s) for s in args.shard_ids.split(",") if s.strip()]
+    if not shard_ids:
+        raise SystemExit("shard proc: --shard-ids is empty")
+    set_flight_identity(shard_proc=args.proc_index)
+    if args.flight_path:
+        import signal
+
+        from r2d2dpg_tpu.obs import get_flight_recorder
+
+        flight_path = args.flight_path
+        if os.path.exists(flight_path):
+            # A predecessor incarnation's dump is post-mortem EVIDENCE
+            # (fleet/actor.py's rule): dump beside it, never over it.
+            root, ext = os.path.splitext(flight_path)
+            flight_path = f"{root}.pid{os.getpid()}{ext}"
+        get_flight_recorder().install(flight_path)
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    try:
+        wire_config = wire.WireConfig(
+            encoding=args.wire, compress=args.compress
+        ).validate()
+    except ValueError as e:
+        raise SystemExit(f"shard proc {args.proc_index}: --compress: {e}")
+    auth_token = args.fleet_token
+    if auth_token is None:
+        auth_token = os.environ.get("R2D2DPG_FLEET_TOKEN") or None
+    chaos = None
+    if args.chaos_spec:
+        try:
+            chaos = fleet_chaos.ShardChaos(
+                fleet_chaos.parse_chaos_spec(args.chaos_spec),
+                seed=args.seed,
+                num_shard_procs=args.num_shard_procs,
+                proc_index=args.proc_index,
+            )
+        except ValueError as e:
+            raise SystemExit(f"shard proc {args.proc_index}: {e}")
+    servers = []
+    for sid in shard_ids:
+        servers.append(
+            ShardServer(
+                ReplayShard(
+                    args.capacity,
+                    alpha=args.alpha,
+                    prioritized=bool(args.prioritized),
+                    shard_id=sid,
+                ),
+                address=args.bind,
+                epoch=args.epoch,
+                seed=args.seed,
+                wire_config=wire_config,
+                max_frame_bytes=args.max_frame_bytes,
+                read_deadline_s=args.read_deadline,
+                auth_token=auth_token,
+                chaos=chaos,
+            ).start()
+        )
+    if args.address_file:
+        # Atomic publish AFTER every listener is bound: a reader never
+        # sees a partial incarnation (tmp + rename, the counter-sidecar
+        # discipline).
+        tmp = f"{args.address_file}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            for srv in servers:
+                f.write(f"{srv.shard.shard_id} {srv.address}\n")
+        os.replace(tmp, args.address_file)
+    flight_event(
+        "shard_start",
+        proc=args.proc_index,
+        epoch=args.epoch,
+        shards=shard_ids,
+    )
+    print(  # obs-lint: allow — CLI entrypoint, routed to the shard log
+        f"shard proc {args.proc_index} epoch {args.epoch}: serving "
+        + ", ".join(f"shard {s.shard.shard_id} on {s.address}" for s in servers),
+        flush=True,
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+if __name__ == "__main__":
+    main()

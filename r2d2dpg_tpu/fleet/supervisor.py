@@ -51,12 +51,20 @@ class _ActorSlot:
 
 
 class ActorSupervisor:
-    """Owns ``num_actors`` actor subprocesses for the life of a fleet run.
+    """Owns ``num_actors`` worker subprocesses for the life of a fleet run.
 
-    ``argv_fn(actor_id)`` builds each actor's command line (train.py wires
+    ``argv_fn(actor_id)`` builds each worker's command line (train.py wires
     ``python -m r2d2dpg_tpu.fleet.actor ...`` with the ingest address);
-    ``log_path_fn(actor_id)``, when given, routes the actor's
-    stdout/stderr to a per-actor file for post-mortems.
+    ``log_path_fn(actor_id)``, when given, routes the worker's
+    stdout/stderr to a per-worker file for post-mortems.
+
+    ``role`` names the supervised process class: ``"actor"`` (default,
+    the historical metric/event names) or ``"shard"`` (the standalone
+    replay-shard tier, ISSUE 12 — ``r2d2dpg_shard_alive`` /
+    ``r2d2dpg_shard_restarts_total`` gauges, ``shard_crash`` /
+    ``shard_restart`` / ``shard_gave_up`` flight events).  The whole
+    backoff/give-up/terminal-exit ladder is role-agnostic — one
+    supervision contract for every fleet process class.
     """
 
     def __init__(
@@ -68,6 +76,8 @@ class ActorSupervisor:
         env: Optional[Dict[str, str]] = None,
         log_path_fn: Optional[Callable[[int], str]] = None,
         clock: Callable[[], float] = time.monotonic,
+        role: str = "actor",
+        id_field: Optional[str] = None,
     ):
         if num_actors < 1:
             raise ValueError("num_actors must be >= 1")
@@ -75,6 +85,13 @@ class ActorSupervisor:
         self.num_actors = num_actors
         self.config = config
         self.log_path_fn = log_path_fn
+        self.role = role
+        # The flight-event key carrying the supervised slot index.  The
+        # shard tier names it "shard_proc": its slot is a PROCESS hosting
+        # M/N shards, and reusing "shard" would collide with the shard-ID
+        # unit the learner's shard_dead/shard_rejoin events carry — a
+        # flight-merge post-mortem must never conflate the two.
+        self.id_field = id_field or role
         # Injectable clock: the backoff/give-up timing contract is tested
         # against a FAKE clock (tests drive _poll_once directly), so the
         # healthy-uptime reset and restart_at deadlines are pinned without
@@ -92,18 +109,26 @@ class ActorSupervisor:
         self._lock = threading.Lock()
         self._stopping = threading.Event()
         self._monitor: Optional[threading.Thread] = None
-        # Fleet health at scrape time (ISSUE 6): the central actor-health
+        # Fleet health at scrape time (ISSUE 6): the central process-health
         # view Ape-X-scale fleets live on — live process count (set_fn:
-        # evaluated per scrape) and cumulative restarts.
+        # evaluated per scrape) and cumulative restarts.  Metric names are
+        # per-ROLE so an actor fleet and a shard tier in one learner never
+        # share (or clobber) a series.
         reg = get_registry()
+        if role == "actor":
+            alive_name = "r2d2dpg_fleet_actors_alive"
+            restarts_name = "r2d2dpg_fleet_actor_restarts_total"
+        else:
+            alive_name = f"r2d2dpg_{role}_alive"
+            restarts_name = f"r2d2dpg_{role}_restarts_total"
         self._obs_alive = reg.gauge(
-            "r2d2dpg_fleet_actors_alive",
-            "live supervised actor subprocesses",
+            alive_name,
+            f"live supervised {role} subprocesses",
         )
         self._obs_alive.set_fn(lambda: float(self.alive_count()))
         self._obs_restarts = reg.counter(
-            "r2d2dpg_fleet_actor_restarts_total",
-            "supervised actor restarts (crash -> backoff -> respawn)",
+            restarts_name,
+            f"supervised {role} restarts (crash -> backoff -> respawn)",
         )
 
     # ------------------------------------------------------------- lifecycle
@@ -220,8 +245,8 @@ class ActorSupervisor:
                         cfg.backoff_max_s,
                     )
                     flight_event(
-                        "actor_crash",
-                        actor=actor_id,
+                        f"{self.role}_crash",
+                        **{self.id_field: actor_id},
                         returncode=rc,
                         restarts=slot.restarts,
                         backoff_s=round(backoff, 3),
@@ -234,8 +259,8 @@ class ActorSupervisor:
                         # terminal event instead of churning forever.
                         slot.gave_up = True
                         flight_event(
-                            "actor_gave_up",
-                            actor=actor_id,
+                            f"{self.role}_gave_up",
+                            **{self.id_field: actor_id},
                             restarts=slot.restarts,
                             reason=TERMINAL_ACTOR_EXITS[rc],
                         )
@@ -246,8 +271,8 @@ class ActorSupervisor:
                     ):
                         slot.gave_up = True
                         flight_event(
-                            "actor_gave_up",
-                            actor=actor_id,
+                            f"{self.role}_gave_up",
+                            **{self.id_field: actor_id},
                             restarts=slot.restarts,
                         )
                         continue
@@ -264,8 +289,8 @@ class ActorSupervisor:
                         self._spawn(actor_id)
                     except Exception as e:  # noqa: BLE001
                         flight_event(
-                            "actor_spawn_failed",
-                            actor=actor_id,
+                            f"{self.role}_spawn_failed",
+                            **{self.id_field: actor_id},
                             error=f"{type(e).__name__}: {e}",
                         )
                         slot.restart_at = now + cfg.backoff_max_s
@@ -273,8 +298,8 @@ class ActorSupervisor:
                     slot.restarts += 1
                     self._obs_restarts.inc()
                     flight_event(
-                        "actor_restart",
-                        actor=actor_id,
+                        f"{self.role}_restart",
+                        **{self.id_field: actor_id},
                         restarts=slot.restarts,
                     )
 

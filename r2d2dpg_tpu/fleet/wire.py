@@ -540,6 +540,7 @@ def pack_shard_batch(
     probs: np.ndarray,
     priority_sum: float,
     occupancy: int,
+    epoch: int = 0,
 ) -> List[Any]:
     """BATCH payload: a shard's training-ready answer.  ``slots``/``gens``
     are the write-back handles (PRIO frames echo them; a generation the
@@ -550,11 +551,21 @@ def pack_shard_batch(
     advertisement exists FOR the cross-process deployment: a remote
     learner refreshes its quota weights from these fields instead of a
     separate poll frame, which is why ``unpack_shard_batch`` validates
-    them even though today's loopback never consumes them."""
+    them even though the loopback never consumes them.
+
+    ``epoch`` is the shard INCARNATION fence (ISSUE 12): a standalone
+    shard process (fleet/shard.py) stamps its supervisor-assigned epoch
+    into every BATCH, and the learner echoes it back in the PRIO
+    write-back — a restarted shard comes back empty under a bumped
+    epoch, so handles sampled from the previous incarnation can never
+    clobber the new ring (slot generations restart at zero and WOULD
+    collide without the fence).  The in-learner loopback has exactly one
+    incarnation and packs the constant 0."""
     return packer.pack(
         {
             "req_id": int(req_id),
             "shard": int(shard),
+            "epoch": int(epoch),
             "priority_sum": float(priority_sum),
             "occupancy": int(occupancy),
             "slots": np.ascontiguousarray(slots, np.int64),
@@ -570,6 +581,7 @@ def unpack_shard_batch(obj: Any) -> Dict[str, Any]:
         isinstance(obj, dict)
         and isinstance(obj.get("req_id"), int)
         and isinstance(obj.get("shard"), int)
+        and isinstance(obj.get("epoch"), int)
         and isinstance(obj.get("staged"), StagedSequences)
         # The advertisement fields must be well-formed even though the
         # in-process loopback reads shard sums directly: a cross-process
@@ -596,8 +608,12 @@ def unpack_shard_batch(obj: Any) -> Dict[str, Any]:
     # Range discipline (the validate-before-touch contract): a negative
     # shard index or slot from a confused/hostile peer must refuse HERE,
     # not alias to python negative indexing in the shard's ring arrays.
-    if obj["shard"] < 0 or (n and int(obj["slots"].min()) < 0):
-        raise WireFormatError("BATCH shard/slots must be >= 0")
+    if (
+        obj["shard"] < 0
+        or obj["epoch"] < 0
+        or (n and int(obj["slots"].min()) < 0)
+    ):
+        raise WireFormatError("BATCH shard/epoch/slots must be >= 0")
     return obj
 
 
@@ -608,14 +624,20 @@ def pack_prio_update(
     slots: np.ndarray,
     gens: np.ndarray,
     priorities: np.ndarray,
+    epoch: int = 0,
 ) -> List[Any]:
     """PRIO payload: learner TD-error write-back, keyed (shard, slot,
     generation) — the reverse ride of the versioned param-publish path.
     ``priorities`` stays float32 on every lane (``F32_PINNED_LEAVES``:
-    it feeds the sampling CDF)."""
+    it feeds the sampling CDF).  ``epoch`` echoes the BATCH the handles
+    came from (``pack_shard_batch``): a standalone shard ignores a PRIO
+    whose epoch is not its own — a verdict about a previous incarnation's
+    ring must never touch the restarted one (slot generations restart at
+    zero, so without the fence stale handles would falsely match)."""
     return packer.pack(
         {
             "shard": int(shard),
+            "epoch": int(epoch),
             "slots": np.ascontiguousarray(slots, np.int64),
             "gens": np.ascontiguousarray(gens, np.int64),
             "priorities": np.ascontiguousarray(priorities, np.float32),
@@ -627,6 +649,7 @@ def unpack_prio_update(obj: Any) -> Dict[str, Any]:
     if not (
         isinstance(obj, dict)
         and isinstance(obj.get("shard"), int)
+        and isinstance(obj.get("epoch"), int)
         and all(
             isinstance(obj.get(k), np.ndarray)
             for k in ("slots", "gens", "priorities")
@@ -636,8 +659,12 @@ def unpack_prio_update(obj: Any) -> Dict[str, Any]:
     n = obj["slots"].shape[0]
     if not (obj["gens"].shape == (n,) and obj["priorities"].shape == (n,)):
         raise WireFormatError("PRIO handles/priorities length mismatch")
-    if obj["shard"] < 0 or (n and int(obj["slots"].min()) < 0):
-        raise WireFormatError("PRIO shard/slots must be >= 0")
+    if (
+        obj["shard"] < 0
+        or obj["epoch"] < 0
+        or (n and int(obj["slots"].min()) < 0)
+    ):
+        raise WireFormatError("PRIO shard/epoch/slots must be >= 0")
     return obj
 
 

@@ -88,6 +88,7 @@ class ReplayShard:
         alpha: float = 0.6,
         prioritized: bool = True,
         shard_id: int = 0,
+        evict_cb=None,
     ):
         if capacity < 1:
             raise ValueError("shard capacity must be >= 1")
@@ -102,6 +103,14 @@ class ReplayShard:
         self._generation = np.zeros((capacity,), np.int64)
         self._cursor = 0
         self.total_added = 0
+        # FIFO-eviction visibility (ISSUE 12 satellite): ring overwrites of
+        # FILLED slots replaced shedding in PR 10 but left no trace — a
+        # too-small shard silently recycled experience faster than the
+        # learner could sample it.  Counted here; ``evict_cb(n)`` (when
+        # given) bumps the owner's obs counter under the same add, so the
+        # count and the metric can never drift.
+        self.evictions_total = 0
+        self._evict_cb = evict_cb
 
     # ------------------------------------------------------------------ add
     def _alloc(self, seq: SequenceBatch) -> None:
@@ -136,6 +145,11 @@ class ReplayShard:
                 prios = np.asarray(priorities, np.float64)
             prios = np.maximum(prios, PRIORITY_EPS)
             idx = (self._cursor + np.arange(b)) % self.capacity
+            evicted = int((self._priority[idx] > 0).sum())
+            if evicted:
+                self.evictions_total += evicted
+                if self._evict_cb is not None:
+                    self._evict_cb(evicted)
             jax.tree_util.tree_map(
                 lambda buf, new: buf.__setitem__(idx, np.asarray(new)),
                 self._data,

@@ -95,6 +95,11 @@ class Topology:
     learner_dp: int = 0
     spmd: int = 0
     pipeline: bool = False
+    # Where the sampler path's shards LIVE (ISSUE 12): 0 = in-learner
+    # loopback (PR 10, the pinned off-setting), N = supervised standalone
+    # shard processes (fleet/shard.py) — a deployment refinement of the
+    # sharded_rings/two_level stages, not a new stage.
+    shard_procs: int = 0
 
     def describe(self) -> str:
         return (
@@ -102,6 +107,7 @@ class Topology:
             f"sample={self.sample} learn={self.learn} "
             f"schedule={self.schedule} actors={self.actors} "
             f"replay_shards={self.replay_shards} "
+            f"shard_procs={self.shard_procs} "
             f"learner_dp={self.learner_dp} spmd={self.spmd}"
         )
 
@@ -145,6 +151,7 @@ def resolve(args) -> Topology:
         learner_dp=int(args.learner_dp or 0),
         spmd=int(args.spmd or 0),
         pipeline=bool(args.pipeline),
+        shard_procs=int(getattr(args, "shard_procs", 0) or 0),
     )
 
 
@@ -189,6 +196,16 @@ def _chaos_sampler_faults(a) -> bool:
 
     return any(
         f.kind in SAMPLER_FAULTS for f in parse_chaos_spec(a.chaos_spec)
+    )
+
+
+def _chaos_shard_faults(a) -> bool:
+    if not a.chaos_spec or getattr(a, "shard_procs", 0):
+        return False
+    from r2d2dpg_tpu.fleet.chaos import SHARD_FAULTS, parse_chaos_spec
+
+    return any(
+        f.kind in SHARD_FAULTS for f in parse_chaos_spec(a.chaos_spec)
     )
 
 
@@ -331,6 +348,37 @@ REFUSALS: Tuple[Refusal, ...] = (
     # sampler's pulled [K, B] batch lands mesh-sharded via
     # Trainer._put_staged(axis=1)); its anchor is
     # tests/test_topology.py::test_sampler_dp_learn_anchor_bitwise.
+    # ------------------------------------------------- standalone shards
+    Refusal(
+        key="shard-procs-without-sampler-path",
+        when=lambda a, np: bool(
+            getattr(a, "shard_procs", 0)
+            and not (a.actors and a.replay_shards)
+        ),
+        reason=(
+            "--shard-procs N requires --actors N --replay-shards M: the "
+            "standalone shard tier hosts the sampler path's replay "
+            "shards, which are fed by actor SEQS traffic "
+            "(--shard-procs 0 is the in-learner loopback — the pinned "
+            "off-setting; docs/TOPOLOGY.md)"
+        ),
+        match="requires --actors",
+        argv=("--shard-procs", "2"),
+    ),
+    Refusal(
+        key="shard-chaos-without-shard-procs",
+        when=lambda a, np: _chaos_shard_faults(a),
+        reason=(
+            "--chaos-spec shard-tier faults (kill_shard/stall_shard/"
+            "partition_shard) drill the standalone shard processes and "
+            "require --shard-procs N: the in-learner loopback shards "
+            "share the learner's failure domain, so there is no shard to "
+            "kill, stall, or partition independently (docs/TOPOLOGY.md)"
+        ),
+        match="shard-procs",
+        argv=("--actors", "2", "--replay-shards", "2",
+              "--chaos-spec", "kill_shard@p2"),
+    ),
     # ------------------------------------------------------- dp learner
     Refusal(
         key="learner-dp-x-spmd",
@@ -437,6 +485,19 @@ def validate(args, process_count: int = 1) -> Topology:
     # assume e.g. a parseable --chaos-spec).
     if args.replay_shards and args.replay_shards < 1:
         raise SystemExit("--replay-shards must be >= 1 (0 = off)")
+    shard_procs = int(getattr(args, "shard_procs", 0) or 0)
+    if shard_procs < 0:
+        raise SystemExit("--shard-procs must be >= 0 (0 = in-learner loopback)")
+    if (
+        shard_procs
+        and args.replay_shards
+        and args.replay_shards % shard_procs
+    ):
+        raise SystemExit(
+            f"--shard-procs: {args.replay_shards} replay shards not "
+            f"divisible by {shard_procs} shard processes (contiguous "
+            f"equal slices per process)"
+        )
     if args.learner_dp and args.learner_dp < 1:
         raise SystemExit("--learner-dp must be >= 1 (0 = off)")
     if args.fleet_heartbeat is not None and args.fleet_heartbeat <= 0:
@@ -487,12 +548,14 @@ def build_trainer(topo: Topology, cfg, make_mesh=None):
 
 
 def build_fleet_learner(topo: Topology, trainer, fleet_config,
-                        replay_capacity=None):
+                        replay_capacity=None, shard_set=None):
     """Assemble the ingest+sample+learn composition for a fleet run:
     ``sharded_rings``/``two_level`` -> ``SamplerLearner`` (pull loop),
     ``central_drain``/``arena`` -> ``FleetLearner`` (drain loop).  Both
     compose with a dp-mesh trainer (the staged/pulled batches are placed
-    through ``Trainer._put_staged``)."""
+    through ``Trainer._put_staged``).  ``shard_set`` (the standalone
+    tier's ``RemoteShardSet``, ISSUE 12) moves the sampler path's shards
+    out of process — ``None`` keeps the in-learner loopback."""
     if topo.sample == "two_level":
         from r2d2dpg_tpu.fleet.sampler import SamplerLearner
 
@@ -502,6 +565,7 @@ def build_fleet_learner(topo: Topology, trainer, fleet_config,
                 fleet_config,
                 num_shards=topo.replay_shards,
                 total_capacity=replay_capacity,
+                shard_set=shard_set,
             )
         except ValueError as e:
             raise SystemExit(f"--replay-shards: {e}")

@@ -140,6 +140,18 @@ def parse_args(argv=None) -> argparse.Namespace:
         "and routes the untouched phase-locked loop — the determinism "
         "anchor).  0 = off (central drain)"
     )
+    p.add_argument(
+        "--shard-procs", type=int, default=0, metavar="N",
+        help="host the --replay-shards M replay shards in N supervised "
+        "STANDALONE shard processes (fleet/shard.py; M %% N == 0, one "
+        "listening socket per shard, HELLO-auth'd frames on the "
+        "negotiated wire lane): the replay tier becomes its own failure "
+        "domain — a dead shard degrades sampling (quotas renormalize "
+        "over survivors within a phase, handlers re-route), never "
+        "training, and the supervisor's backoff restart rejoins it EMPTY "
+        "under a bumped epoch that fences stale BATCH/PRIO traffic.  "
+        "0 = in-learner loopback (PR 10's path, pinned bit-identical)"
+    )
     # Fleet fault tolerance (docs/FLEET.md "Failure modes & recovery").
     p.add_argument(
         "--fleet-heartbeat", type=float, default=None, metavar="S",
@@ -889,15 +901,55 @@ def _run_fleet(
     # no chance to desynchronize).
     if topo is None:
         topo = topology.resolve(args)
+    # Standalone shard tier (ISSUE 12, --shard-procs N): spawn the shard
+    # processes FIRST (their address files appear asynchronously; every
+    # learner-side dial waits them out), hand the RemoteShardSet to the
+    # sampler learner in place of the in-learner loopback.
+    shard_tier = None
+    if args.shard_procs:
+        from r2d2dpg_tpu.fleet.shard import ShardProcTier
+
+        if args.logdir:
+            shard_dir = os.path.join(args.logdir, "shards")
+        else:
+            import tempfile
+
+            shard_dir = tempfile.mkdtemp(prefix="r2d2dpg_shards_")
+        shard_tier = ShardProcTier(
+            num_shards=args.replay_shards,
+            num_procs=args.shard_procs,
+            capacity_per_shard=replay_capacity // args.replay_shards,
+            alpha=cfg.trainer.priority_alpha,
+            prioritized=cfg.trainer.prioritized,
+            dirpath=shard_dir,
+            seed=cfg.trainer.seed,
+            wire_config=wire_config,
+            auth_token=fleet_token,
+            max_frame_bytes=fleet_config.max_frame_bytes,
+            heartbeat_s=heartbeat_s,
+            chaos_spec=args.chaos_spec,
+            flight_dir=args.logdir,
+        )
     learner = topology.build_fleet_learner(
-        topo, trainer, fleet_config, replay_capacity=replay_capacity
+        topo, trainer, fleet_config, replay_capacity=replay_capacity,
+        shard_set=shard_tier.shard_set if shard_tier is not None else None,
     )
+    # NB the tier's processes are SPAWNED inside the try below (beside the
+    # actor supervisor): anything that can SystemExit before then — a
+    # --resume with no checkpoint, a bind failure — must not orphan
+    # shard processes whose only exit is the supervisor's stop.
     address = learner.start()
     print(
         f"fleet: ingest on {address}; spawning {args.actors} actors"
         + (
             f"; {args.replay_shards} replay shards (learner-pulled "
-            f"sampling)"
+            f"sampling"
+            + (
+                f", {args.shard_procs} standalone shard procs"
+                if args.shard_procs
+                else ""
+            )
+            + ")"
             if args.replay_shards
             else ""
         ),
@@ -1009,6 +1061,7 @@ def _run_fleet(
             num_actors=args.actors,
             supervisor=supervisor,
             server=learner.server,
+            shard_tier=shard_tier,
         )
 
     if args.phases is not None:
@@ -1028,6 +1081,8 @@ def _run_fleet(
         # sidecar instead, so only the sampler takes the rate directly.
         run_kwargs["trace_sample"] = args.trace_sample
     try:
+        if shard_tier is not None:
+            shard_tier.start()
         supervisor.start()
         state = learner.run(
             num_phases,
@@ -1043,6 +1098,8 @@ def _run_fleet(
         )
         _fold_executor_stats("fleet", learner.stats(), final)
         final["fleet_actor_restarts"] = float(supervisor.restarts_total)
+        if shard_tier is not None:
+            final["fleet_shard_restarts"] = float(shard_tier.restarts_total)
         if engine is not None and engine.unfired():
             # A drill that never got its phase must not read as one that
             # passed: name it loudly in the log and the flight ring.
@@ -1071,8 +1128,14 @@ def _run_fleet(
         _abort_on_divergence(e, flight, flight_path, ckpt)
     finally:
         # Supervisor FIRST (its stopping flag makes the actors' connection
-        # loss an orderly exit, not a crash to restart), then the server.
+        # loss an orderly exit, not a crash to restart), then the SHARD
+        # TIER (its stop flag releases any ingest handler parked in the
+        # tier-down wait inside RemoteShardSet.add — closing the ingest
+        # server first would eat a join timeout per wedged handler and
+        # log false handler leaks), then the ingest server.
         supervisor.stop()
+        if shard_tier is not None:
+            shard_tier.stop()
         learner.close()
         # Sampled spans -> trace.json next to flight.jsonl (no-op when
         # tracing is off or no dump path is armed).
@@ -1094,10 +1157,20 @@ def _run_fleet(
             seed=cfg.trainer.seed,
             num_actors=args.actors,
         )
+        if args.shard_procs:
+            # Shard-process-boundary drills (stall_shard) fire in the
+            # SHARD processes; the same no-evidence-means-unfired
+            # contract applies to their flight_shard*.jsonl dumps.
+            missing += fleet_chaos.shard_faults_unfired(
+                chaos_faults,
+                args.logdir,
+                seed=cfg.trainer.seed,
+                num_shard_procs=args.shard_procs,
+            )
         if missing:
             names = [f"{f.kind}@p{f.phase}" for f in missing]
             print(
-                f"fleet: WARNING — actor-side chaos faults left no "
+                f"fleet: WARNING — actor/shard-side chaos faults left no "
                 f"injection evidence in {args.logdir!r} (run too short? "
                 f"target kept crashing?): {', '.join(names)}",
                 flush=True,

@@ -148,6 +148,10 @@ run_evidence() {
         echo "$dir: sampler equivalence gate FAILED (attempt $attempt)"
         continue
       fi
+      if ! shard_gate "$dir" "$@"; then
+        echo "$dir: shard-tier gate FAILED (attempt $attempt)"
+        continue
+      fi
       if ! topology_gate "$dir" "$@"; then
         echo "$dir: composed-topology gate FAILED (attempt $attempt)"
         continue
@@ -366,6 +370,55 @@ sampler_gate() {
          -k 'determinism or equivalence' \
        > "$dir/sampler_gate.log" 2>&1; then
     touch "$dir/.sampler_equivalence_ok"
+    return 0
+  fi
+  return 1
+}
+
+# Standalone-shard-tier gate (ISSUE 12): a run dir trained with
+# --shard-procs N may only be blessed (.done) if the shard-tier anchors
+# pass on this checkout — the loopback-vs-out-of-process determinism
+# anchor (a BATCH through a real socket decodes bit-identically to the
+# in-learner loopback; plus the --shard-procs 0 off-setting riding the
+# sampler CLI anchor) AND the non-slow kill_shard chaos drill (2 actors
+# x 2 shard procs: run completes, quotas renormalize to the survivor,
+# the restarted shard rejoins under a bumped epoch, stale-epoch frames
+# fenced — docs/REPLAY.md "Standalone shard tier").  The resolved proc
+# count is stamped into the evidence dir (shard_procs.txt) beside
+# replay_shards.txt, so a blessed number always says where replay
+# LIVED.  Same stamping discipline as fleet_gate; loopback runs pass
+# through untouched.
+#   shard_gate <dir> <train args...>
+shard_gate() {
+  local dir=$1
+  shift
+  local _sp="" _sp_prev=""
+  local _sp_arg
+  for _sp_arg in "$@"; do
+    # Both argparse spellings: "--flag value" and "--flag=value".
+    case "$_sp_arg" in
+      --shard-procs=*) _sp=${_sp_arg#*=} ;;
+    esac
+    case "$_sp_prev" in
+      --shard-procs) _sp=$_sp_arg ;;
+    esac
+    _sp_prev=$_sp_arg
+  done
+  if [ -z "$_sp" ] || [ "$_sp" = 0 ]; then
+    return 0  # in-learner loopback (or no sampler path): nothing to gate
+  fi
+  printf 'shard_procs=%s\n' "$_sp" > "$dir/shard_procs.txt"
+  if [ -f "$dir/.shard_tier_ok" ]; then
+    return 0
+  fi
+  if timeout --kill-after=30 900 \
+       env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+       XLA_FLAGS= \
+       python -m pytest tests/test_shard.py tests/test_sampler.py \
+         -q -p no:cacheprovider -m 'not slow' \
+         -k 'determinism or kill_shard' \
+       > "$dir/shard_gate.log" 2>&1; then
+    touch "$dir/.shard_tier_ok"
     return 0
   fi
   return 1

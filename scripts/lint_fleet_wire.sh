@@ -2,13 +2,17 @@
 # lint_fleet_wire.sh — no pickle on the fleet's SEQS/PARAMS steady-state
 # paths (ISSUE 5 satellite).
 #
-# The tensor hot path (SEQS experience frames, PARAMS snapshot pushes)
-# must go through the zero-copy codec in fleet/wire.py: pickle re-copies
-# every tensor byte on both ends and executes arbitrary callables on
-# load.  Control frames (HELLO/ACK/BYE — tiny trusted dicts) may keep
-# pickle via transport.pack_obj/unpack_obj, but ONLY at call sites
-# annotated `# wire-lint: control`, so every pickle crossing is an
-# audited whitelist entry, not a drift risk.
+# The tensor hot path (SEQS experience frames, PARAMS snapshot pushes,
+# and the shard tier's SEQS/SAMPLE_REQ/BATCH/PRIO traffic — fleet/shard.py
+# speaks the same codec on both of its legs, ISSUE 12) must go through
+# the zero-copy codec in fleet/wire.py: pickle re-copies every tensor
+# byte on both ends and executes arbitrary callables on load.  Control
+# frames (HELLO/ACK/BYE — tiny trusted dicts) may keep pickle via
+# transport.pack_obj/unpack_obj, but ONLY at call sites annotated
+# `# wire-lint: control`, so every pickle crossing is an audited
+# whitelist entry, not a drift risk.  The rules below scan ALL of
+# r2d2dpg_tpu/fleet/ recursively, so a new fleet module (shard.py being
+# the latest) is covered the day it lands.
 #
 # Rules:
 #   1. The token `pickle` may appear in fleet/ only inside transport.py

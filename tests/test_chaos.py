@@ -135,6 +135,29 @@ def test_parse_chaos_spec_sampler_faults():
     assert {"kill_sampler_conn", "stall_sampler"} <= LEARNER_FAULTS
 
 
+def test_parse_chaos_spec_shard_faults():
+    """The standalone shard tier class (ISSUE 12): kill_shard and
+    partition_shard fire learner-side (supervisor SIGKILL / both-legs
+    conn drop), stall_shard (duration required) fires inside the target
+    shard process."""
+    from r2d2dpg_tpu.fleet.chaos import (
+        LEARNER_FAULTS,
+        SHARD_FAULTS,
+        SHARD_PROC_FAULTS,
+    )
+
+    faults = parse_chaos_spec(
+        "kill_shard@p2,stall_shard@p3:2s,partition_shard@p4"
+    )
+    assert [f.kind for f in faults] == [
+        "kill_shard", "stall_shard", "partition_shard",
+    ]
+    assert faults[1].duration_s == 2.0
+    assert {"kill_shard", "partition_shard"} <= LEARNER_FAULTS
+    assert SHARD_PROC_FAULTS == {"stall_shard"}
+    assert SHARD_FAULTS == {"kill_shard", "stall_shard", "partition_shard"}
+
+
 @pytest.mark.parametrize(
     "bad",
     [
@@ -147,6 +170,8 @@ def test_parse_chaos_spec_sampler_faults():
         "stall_actor@p2",  # stall without a duration
         "kill_sampler_conn@p2:3s",  # duration on a non-stall fault
         "stall_sampler@p2",  # stall without a duration
+        "kill_shard@p2:3s",  # duration on a non-stall fault
+        "stall_shard@p2",  # stall without a duration
         "kill_actor@p1,,kill_actor@p2",
     ],
 )

@@ -43,19 +43,21 @@ def _host_staged(trainer, state):
 
 
 # ------------------------------------------------------- determinism anchor
-def test_learner_dp1_actors0_determinism_bit_identical(tmp_path):
+def test_learner_dp1_actors0_determinism_bit_identical(
+    tmp_path, phase_locked_reference_k10
+):
     """--learner-dp 1 --actors 0 == the untouched phase-locked Trainer.run,
     leaf-for-leaf bitwise, END TO END through the train.py CLI path — the
     degenerate 1-device mesh must annotate layouts without changing one
     bit of the trajectory (learner_dp_gate runs this by its 'determinism'
-    name)."""
+    name).  The reference half is the shared session fixture
+    (tests/conftest.py) — the pairing assert keeps it honest."""
     from r2d2dpg_tpu import train
     from r2d2dpg_tpu.utils import CheckpointManager
     from r2d2dpg_tpu.utils.checkpoint import resume_state
 
-    t1 = PENDULUM_TINY.build()
-    warm, fill = t1.window_fill_phases, t1.replay_fill_phases
-    s1 = t1.run(warm + fill + N_TRAIN, log_every=LOG_EVERY, log_fn=lambda *_: None)
+    assert (N_TRAIN, LOG_EVERY) == (10, 3)  # the k10 fixture's recipe
+    s1 = phase_locked_reference_k10
 
     train.run(
         train.parse_args(

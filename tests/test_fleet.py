@@ -75,18 +75,20 @@ def _np_staged(b=2, l=3):
 
 
 # ------------------------------------------------------- determinism anchor
-def test_fleet_off_determinism_bit_identical(tmp_path):
+def test_fleet_off_determinism_bit_identical(
+    tmp_path, phase_locked_reference_k10
+):
     """--actors 0 == the untouched phase-locked Trainer.run, leaf-for-leaf
     bitwise, measured END TO END through the train.py CLI path (parse ->
     guards -> loop -> final checkpoint) so the fleet wiring itself is what
-    is pinned."""
+    is pinned.  The reference half is the shared session fixture
+    (tests/conftest.py) — the pairing assert keeps it honest."""
     from r2d2dpg_tpu import train
     from r2d2dpg_tpu.utils import CheckpointManager
     from r2d2dpg_tpu.utils.checkpoint import resume_state
 
-    t1 = PENDULUM_TINY.build()
-    warm, fill = t1.window_fill_phases, t1.replay_fill_phases
-    s1 = t1.run(warm + fill + N_TRAIN, log_every=LOG_EVERY, log_fn=lambda *_: None)
+    assert (N_TRAIN, LOG_EVERY) == (10, 3)  # the k10 fixture's recipe
+    s1 = phase_locked_reference_k10
 
     train.run(
         train.parse_args(
@@ -861,21 +863,21 @@ def test_fleet_learner_checkpoint_resume_in_process(tmp_path):
 
 
 @pytest.mark.slow
-def test_fleet_off_save_resume_determinism_bit_identical(tmp_path):
+def test_fleet_off_save_resume_determinism_bit_identical(
+    tmp_path, phase_locked_reference_k10
+):
     """ISSUE 7's extended anchor: the --actors 0 CLI path stays bitwise
     identical to the unbroken ``Trainer.run`` ACROSS a save/resume
     round-trip — train k phases, checkpoint, resume in a fresh process
     state for the rest, and the final state matches the unbroken run
-    leaf-for-leaf (fleet_gate runs this by its 'determinism' name)."""
+    leaf-for-leaf (fleet_gate runs this by its 'determinism' name).  The
+    reference half is the shared session fixture (tests/conftest.py)."""
     from r2d2dpg_tpu import train
     from r2d2dpg_tpu.utils import CheckpointManager
     from r2d2dpg_tpu.utils.checkpoint import resume_state
 
-    t1 = PENDULUM_TINY.build()
-    warm, fill = t1.window_fill_phases, t1.replay_fill_phases
-    s1 = t1.run(
-        warm + fill + N_TRAIN, log_every=LOG_EVERY, log_fn=lambda *_: None
-    )
+    assert (N_TRAIN, LOG_EVERY) == (10, 3)  # the k10 fixture's recipe
+    s1 = phase_locked_reference_k10
 
     k = 4
     base = [

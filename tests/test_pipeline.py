@@ -38,14 +38,15 @@ def _leaves_equal(a, b):
     ]
 
 
-def test_pipeline_off_determinism_bit_identical():
+def test_pipeline_off_determinism_bit_identical(phase_locked_reference_k10):
     """pipeline=off == the phase-locked schedule, leaf-for-leaf bitwise.
 
     Log cadence included: pop_episode_metrics drains device accumulators,
     so a cadence mismatch between the executor and Trainer.run would show
-    up as differing state."""
-    t1 = PENDULUM_TINY.build()
-    s1 = t1.run(N_PHASES, log_every=LOG_EVERY, log_fn=lambda *_: None)
+    up as differing state.  The reference half is the shared session
+    fixture (tests/conftest.py) — this pairing keeps it honest."""
+    assert (N_PHASES, LOG_EVERY) == (14, 3)  # == warm 2 + fill 2 + 10, k10
+    s1 = phase_locked_reference_k10
 
     t2 = PENDULUM_TINY.build()
     ex = PipelineExecutor(t2, PipelineConfig(enabled=False))

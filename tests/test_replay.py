@@ -319,6 +319,86 @@ def test_shard_ring_eviction_semantics():
         empty.sample(1, np.random.default_rng(0))
 
 
+def test_degraded_two_level_sampling_over_surviving_subset():
+    """ISSUE 12 satellite: the degraded-sampling math.  With a dead
+    shard advertising Σp^α = 0 (or simply absent), ``shard_quotas`` over
+    the SURVIVING subset is still a valid distribution (non-negative,
+    sums to n, zero draws for the dead shard), and the two-level draw
+    restricted to survivors matches central proportional sampling
+    restricted to the surviving slots — on exact-integer priorities, the
+    combined probabilities are exactly ``p / Σ_survivors``."""
+    from r2d2dpg_tpu.replay.sharded import (
+        ReplayShard,
+        combine_probs,
+        shard_quotas,
+    )
+
+    prios = np.array([1.0, 2.0, 4.0, 8.0, 5.0, 3.0], np.float64)
+    shards = [ReplayShard(4, alpha=1.0, shard_id=i) for i in range(3)]
+    shards[0].add(_np_batch(2, start=0.0), prios[:2])
+    shards[1].add(_np_batch(2, start=2.0), prios[2:4])  # the dead one
+    shards[2].add(_np_batch(2, start=4.0), prios[4:])
+    # Shard 1 dies: its advertised weight is ZERO (exactly what
+    # RemoteShardSet.scaled_sums reports for a dead shard).
+    sums = np.array(
+        [shards[0].scaled_sum(), 0.0, shards[2].scaled_sum()], np.float64
+    )
+    total = float(sums.sum())
+    surviving = np.array([1.0, 2.0, 5.0, 3.0])  # shards 0 and 2's slots
+    rng = np.random.default_rng(5)
+    counts: dict = {}
+    n_rounds, per_round = 250, 32
+    for _ in range(n_rounds):
+        quotas = shard_quotas(sums, per_round, rng)
+        assert quotas.sum() == per_round and (quotas >= 0).all()
+        assert quotas[1] == 0  # a dead shard NEVER receives draws
+        for sid, q in enumerate(quotas):
+            if q == 0:
+                continue
+            s = shards[sid].sample(int(q), rng)
+            keys = s.seq.reward[:, 0].astype(int)
+            # Combined probability == central proportional RESTRICTED to
+            # the surviving slots, exactly (integer priorities).
+            np.testing.assert_allclose(
+                combine_probs(s.probs, float(sums[sid]), total),
+                prios[keys] / surviving.sum(),
+                rtol=1e-12,
+            )
+            for k in keys:
+                counts[int(k)] = counts.get(int(k), 0) + 1
+    assert set(counts) <= {0, 1, 4, 5}  # no draw from the dead shard
+    freq = np.array(
+        [counts.get(k, 0) for k in (0, 1, 4, 5)], np.float64
+    ) / (n_rounds * per_round)
+    np.testing.assert_allclose(freq, surviving / surviving.sum(), atol=0.02)
+    # An all-dead tier is a caller error, loudly (the sampler WAITS on
+    # this instead of fabricating draws).
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="empty"):
+        shard_quotas([0.0, 0.0, 0.0], 4, np.random.default_rng(0))
+
+
+def test_ring_wrap_eviction_counter_counts():
+    """ISSUE 12 satellite: FIFO eviction (which replaced shedding in
+    PR 10) leaves a trace — ``evictions_total`` counts exactly the
+    FILLED slots the ring overwrote, and the ``evict_cb`` hook (the obs
+    counter's rider) sees the same numbers under the same add lock."""
+    from r2d2dpg_tpu.replay.sharded import ReplayShard
+
+    seen = []
+    s = ReplayShard(4, alpha=1.0, evict_cb=seen.append)
+    s.add(_np_batch(3), np.ones(3))
+    assert s.evictions_total == 0 and seen == []  # filling, not evicting
+    # Wrap: slots 3,0,1 — slot 3 was still EMPTY, 0 and 1 were filled.
+    s.add(_np_batch(3, start=10.0), np.ones(3))
+    assert s.evictions_total == 2 and seen == [2]
+    # Full ring: every further add evicts its whole width.
+    s.add(_np_batch(4, start=20.0), np.ones(4))
+    assert s.evictions_total == 6 and seen == [2, 4]
+    assert s.occupancy() == 4 and s.total_added == 10
+
+
 def test_sampled_batch_contents_roundtrip():
     arena = ReplayArena(capacity=16)
     state = arena.init_state(make_batch(4))

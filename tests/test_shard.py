@@ -1,0 +1,487 @@
+"""Standalone crash-tolerant replay shard tier (ISSUE 12): supervised
+shard processes, quota renormalization on shard loss, epoch-fenced
+rejoin (fleet/shard.py).
+
+Anchors ``scripts/lib_gate.sh shard_gate`` enforces before blessing
+``--shard-procs N`` evidence dirs:
+
+- **determinism** — the loopback-vs-out-of-process boundary is layout,
+  never semantics: a BATCH through a REAL socket decodes bit-identically
+  to the in-learner loopback roundtrip on the f32 lane (plus the
+  ``--shard-procs 0`` off-setting riding the sampler CLI anchor in
+  tests/test_sampler.py).
+- **kill_shard** — the non-slow chaos e2e: 2 actors x 2 shard procs,
+  ``kill_shard`` mid-run -> the run completes, counters stay monotone,
+  quotas renormalize to the surviving shard, the restarted shard rejoins
+  under a bumped epoch and serves traffic, and stale-epoch PRIO frames
+  are ignored with a flight event; ``stall_shard`` pins zero sheds and
+  zero false reaps through the stall.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.configs import PENDULUM_TINY
+from r2d2dpg_tpu.fleet import chaos as fleet_chaos
+from r2d2dpg_tpu.fleet import transport, wire
+from r2d2dpg_tpu.fleet.shard import (
+    RemoteShard,
+    RemoteShardSet,
+    ShardProcTier,
+    ShardServer,
+    ShardUnavailableError,
+)
+from r2d2dpg_tpu.fleet.supervisor import SupervisorConfig
+from r2d2dpg_tpu.obs import get_flight_recorder
+from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
+from r2d2dpg_tpu.replay.sharded import ReplayShard
+
+pytestmark = pytest.mark.shard
+
+
+def _np_staged(b=3, l=3, prios=(1.0, 2.0, 3.0), seed=1):
+    rng = np.random.default_rng(seed)
+    return StagedSequences(
+        seq=SequenceBatch(
+            obs=rng.normal(size=(b, l, 3)).astype(np.float32),
+            action=rng.normal(size=(b, l, 1)).astype(np.float32),
+            reward=rng.normal(size=(b, l)).astype(np.float32),
+            discount=np.ones((b, l), np.float32),
+            reset=np.zeros((b, l), np.float32),
+            carries={},
+        ),
+        priorities=(
+            None if prios is None else np.asarray(prios, np.float64)
+        ),
+    )
+
+
+def _server(shard_id=0, epoch=1, capacity=8, auth=None, chaos=None):
+    return ShardServer(
+        ReplayShard(capacity, alpha=1.0, shard_id=shard_id),
+        epoch=epoch,
+        seed=0,
+        auth_token=auth,
+        chaos=chaos,
+    ).start()
+
+
+def _client(srv, auth=None, **kw):
+    return RemoteShard(
+        srv.shard.shard_id,
+        lambda: srv.address,
+        wire_config=wire.WireConfig(),
+        auth_token=auth,
+        max_frame_bytes=transport.MAX_FRAME_BYTES,
+        read_deadline_s=30.0,
+        **kw,
+    )
+
+
+# ------------------------------------------------------- determinism anchor
+def test_socket_vs_loopback_batch_determinism_bitwise():
+    """The shard_gate anchor: the SAME ShardSample through (a) the
+    in-learner loopback pack/unpack and (b) a REAL ShardServer socket
+    exchange decodes bit-identically on the f32 lane — moving a shard
+    out of process is layout, never semantics."""
+    staged = _np_staged(b=4, prios=(1.0, 2.0, 3.0, 4.0))
+    srv = _server(capacity=8)
+    client = _client(srv)
+    try:
+        # Seed the remote shard, then mirror its exact ring state locally.
+        client.forward_seqs(staged)
+        local = ReplayShard(8, alpha=1.0, shard_id=0)
+        local.add(staged.seq, staged.priorities)
+        # Remote draw (real socket), then replay the identical draw
+        # locally: the shard process seeds its rng (seed, shard, epoch).
+        resp = client.sample(5, req_id=1)
+        rng = np.random.default_rng((0, 0, 1))
+        s = local.sample(5, rng)
+        packer = wire.TreePacker(wire.WireConfig())
+        unpacker = wire.TreeUnpacker()
+        loop = wire.unpack_shard_batch(
+            unpacker.unpack(
+                b"".join(
+                    bytes(p)
+                    for p in wire.pack_shard_batch(
+                        packer,
+                        req_id=1,
+                        shard=0,
+                        staged=StagedSequences(seq=s.seq, priorities=None),
+                        slots=s.slots,
+                        gens=s.gens,
+                        probs=s.probs,
+                        priority_sum=local.scaled_sum(),
+                        occupancy=local.occupancy(),
+                        epoch=1,
+                    )
+                )
+            )
+        )
+        np.testing.assert_array_equal(resp["slots"], loop["slots"])
+        np.testing.assert_array_equal(resp["gens"], loop["gens"])
+        np.testing.assert_array_equal(resp["probs"], loop["probs"])
+        for a, b in zip(
+            [resp["staged"].seq.obs, resp["staged"].seq.reward],
+            [loop["staged"].seq.obs, loop["staged"].seq.reward],
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert resp["epoch"] == loop["epoch"] == 1
+        assert resp["priority_sum"] == loop["priority_sum"]
+    finally:
+        client.close()
+        srv.stop()
+
+
+# ----------------------------------------------------------- shard protocol
+def test_shard_server_auth_epoch_and_stale_prio_fence():
+    """Protocol + fences on one in-process server: HELLO auth refusal,
+    the SEQS ack advertisement, BATCH epoch stamping, and the
+    authoritative shard-side stale-epoch PRIO ignore (applied=0 + flight
+    event + counter) that protects a restarted ring from its
+    predecessor's verdicts."""
+    srv = _server(shard_id=3, epoch=7, auth="sekrit")
+    n0 = len(get_flight_recorder().events())
+    try:
+        # Wrong token: refused at the door.
+        bad = _client(srv, auth="wrong")
+        with pytest.raises(RuntimeError, match="refused"):
+            bad.forward_seqs(_np_staged())
+        bad.close()
+        client = _client(srv, auth="sekrit")
+        ack = client.forward_seqs(_np_staged(prios=(1.0, 2.0, 4.0)))
+        assert ack["code"] == "ok" and ack["epoch"] == 7
+        assert ack["occupancy"] == 3 and ack["scaled_sum"] == 7.0
+        assert ack["priority_sum"] == 7.0 and ack["evictions"] == 0
+        assert client.epoch == 7 and client.occupancy == 3
+        resp = client.sample(2, req_id=5)
+        assert resp["epoch"] == 7 and resp["req_id"] == 5
+        # Fresh-epoch write-back applies; stale-epoch is IGNORED loudly.
+        ok = client.write_back(
+            resp["slots"], resp["gens"],
+            np.full(2, 9.0, np.float32), epoch=7,
+        )
+        assert ok["applied"] == 2 and not ok["stale"]
+        stale = client.write_back(
+            resp["slots"], resp["gens"],
+            np.full(2, 1.0, np.float32), epoch=6,
+        )
+        assert stale["applied"] == 0 and stale["stale"]
+        # A SAMPLE_REQ at a live-but-EMPTY shard answers with an
+        # empty-marked advert ack (None here), never a torn connection —
+        # a stale quota weight meeting a fresh ring must not read as a
+        # dead process (the connection stays usable).
+        empty_srv = _server(shard_id=9, epoch=1)
+        empty_client = _client(empty_srv)
+        try:
+            assert empty_client.sample(3, req_id=1) is None
+            assert empty_client.scaled_sum == 0.0
+            empty_client.forward_seqs(_np_staged())
+            # The SAMPLE leg survived the empty answer: the very same
+            # connection now serves a real BATCH.
+            assert empty_client.sample(2, req_id=2) is not None
+        finally:
+            empty_client.close()
+            empty_srv.stop()
+        evs = [
+            e for e in get_flight_recorder().events()[n0:]
+            if e["kind"] == "stale_epoch_prio_ignored"
+        ]
+        assert evs and evs[-1]["got_epoch"] == 6 and evs[-1]["epoch"] == 7
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_remote_set_reroute_renorm_and_epoch_fenced_rejoin():
+    """The degradation half without processes: kill server 0 (stop =
+    dial refused), the set marks it dead — quota weights zero, routing
+    falls to the survivor in ring order, accounting banks regardless —
+    then a NEW incarnation (bumped epoch) rejoins: routing returns home,
+    the stale advert is zeroed (an empty restarted ring must not inherit
+    the dead ring's sums), and the learner-side epoch fence drops
+    write-backs against the old incarnation."""
+    addrs = {}
+    srv0 = _server(shard_id=0, epoch=1)
+    srv1 = _server(shard_id=1, epoch=1)
+    addrs[0], addrs[1] = srv0.address, srv1.address
+    ss = RemoteShardSet(
+        2,
+        lambda sid: addrs[sid],
+        wire_config=wire.WireConfig(),
+        rejoin_interval_s=0.0,
+    )
+    n0 = len(get_flight_recorder().events())
+    try:
+        for sid in (0, 1):
+            ss.add(sid, {"staged": _np_staged(), "env_steps_delta": 9.0})
+        assert ss.occupancy_total() == 6
+        np.testing.assert_allclose(ss.scaled_sums(), [6.0, 6.0])
+        resp = ss.shards[0].sample(2, req_id=1)
+        handles_epoch = resp["epoch"]
+        # --- death: server 0 gone, dial refused.
+        srv0.stop()
+        with pytest.raises(ShardUnavailableError):
+            ss.shards[0].sample(1, req_id=2)
+        ss._mark_dead(0, "drill")
+        np.testing.assert_allclose(ss.scaled_sums(), [0.0, 6.0])
+        assert ss.route(0) == 1  # home shard dead -> survivor, in ring order
+        # adds (home 0) re-route; the accounting banks either way.
+        ss.add(0, {"staged": _np_staged(), "env_steps_delta": 9.0,
+                   "actor_id": 0})
+        assert ss.shards[1].occupancy == 6  # ring of 8 holds both adds
+        assert ss.pop_stats()["env_steps_delta"] == 27.0
+        # --- rejoin: new incarnation, bumped epoch, empty ring.
+        srv0b = _server(shard_id=0, epoch=2, capacity=8)
+        addrs[0] = srv0b.address
+        ss.maybe_rejoin()
+        assert ss.shards[0].alive and ss.shards[0].epoch == 2
+        assert ss.route(0) == 0  # traffic lands back home
+        # The rejoined ring is EMPTY: its weight stays 0 (the dead ring's
+        # sums are never inherited); the survivor holds both adds' sums.
+        np.testing.assert_allclose(ss.scaled_sums(), [0.0, 12.0])
+        kinds = [e["kind"] for e in get_flight_recorder().events()[n0:]]
+        assert "shard_dead" in kinds and "shard_rejoin" in kinds
+        # Learner-side epoch fence: handles from incarnation 1 never even
+        # cross the wire (fleet/sampler.py groups per (shard, epoch)).
+        assert handles_epoch == 1 != ss.shards[0].epoch
+        srv0b.stop()
+    finally:
+        ss.close()
+        srv1.stop()
+
+
+def test_shard_chaos_stall_gate_arms_and_waits():
+    fs = fleet_chaos.parse_chaos_spec("stall_shard@p2:0.3s")
+    target = fleet_chaos.fault_target(fs[0], seed=0, num_actors=2)
+    chaos = fleet_chaos.ShardChaos(
+        fs, seed=0, num_shard_procs=2, proc_index=target
+    )
+    chaos.on_seqs_frame()
+    t0 = time.monotonic()
+    chaos.gate()
+    assert time.monotonic() - t0 < 0.05  # phase 1: not due yet
+    chaos.on_seqs_frame()  # phase 2: arms the stall
+    t0 = time.monotonic()
+    chaos.gate()
+    assert time.monotonic() - t0 >= 0.25
+    other = fleet_chaos.ShardChaos(
+        fs, seed=0, num_shard_procs=2, proc_index=1 - target
+    )
+    other.on_seqs_frame()
+    other.on_seqs_frame()
+    t0 = time.monotonic()
+    other.gate()
+    assert time.monotonic() - t0 < 0.05  # not its fault
+
+
+# --------------------------------------------------------------- chaos e2e
+@pytest.mark.chaos
+def test_chaos_kill_shard_stall_and_partition_e2e(tmp_path):
+    """The acceptance drill (non-slow, 2 actors x 2 REAL shard procs):
+    ``stall_shard`` + ``partition_shard`` + ``kill_shard`` in one run —
+    the run completes its full phase schedule, counters stay monotone,
+    zero sheds and zero false reaps through the stall, the dead shard's
+    quota renormalizes to the survivor, and after the supervisor's
+    backoff restart the shard rejoins EMPTY under a bumped epoch, serves
+    traffic on both legs, and fences stale-epoch write-backs."""
+    import queue as _q
+
+    from r2d2dpg_tpu.fleet import FleetConfig, SamplerLearner
+    from r2d2dpg_tpu.fleet.transport import (
+        K_ACK,
+        K_HELLO,
+        K_SEQS,
+        pack_hello,
+        recv_frame,
+        send_frame,
+        send_frame_parts,
+    )
+    from r2d2dpg_tpu.training.pipeline import split_state
+
+    SEED = 2  # pinned: stall->proc0, partition->shard1, kill->proc0
+    N_TRAIN = 6
+    spec = "stall_shard@p1:0.6s,partition_shard@p1,kill_shard@p2"
+    faults = fleet_chaos.parse_chaos_spec(spec)
+    assert fleet_chaos.fault_target(faults[2], SEED, 2) == 0  # kill proc 0
+    assert fleet_chaos.fault_target(faults[1], SEED, 2) == 1  # partition 1
+
+    import dataclasses as dc
+
+    import jax
+
+    trainer = PENDULUM_TINY.build()
+    state = trainer.init()
+    _, lstate = split_state(state)
+    # The arena's storage tree IS the staged-batch template (leaves
+    # [capacity, L, ...]): synthetic actors emit exactly the structure
+    # the learn program expects, without paying a collect-program
+    # compile this drill does not test.
+    template = jax.device_get(lstate.arena.data)
+
+    def synth_staged(rng, b=4):
+        data = jax.tree_util.tree_map(
+            lambda buf: (
+                rng.normal(size=(b,) + np.shape(buf)[1:]).astype(buf.dtype)
+                if buf.dtype.kind == "f"
+                else np.zeros((b,) + np.shape(buf)[1:], buf.dtype)
+            ),
+            template,
+        )
+        data = dc.replace(
+            data,
+            discount=np.ones_like(data.discount),
+            reset=np.zeros_like(data.reset),
+        )
+        return StagedSequences(
+            seq=data, priorities=rng.uniform(0.5, 4.0, size=b)
+        )
+
+    tier = ShardProcTier(
+        num_shards=2,
+        num_procs=2,
+        capacity_per_shard=128,
+        alpha=trainer.config.priority_alpha,
+        prioritized=True,
+        dirpath=str(tmp_path / "shards"),
+        seed=SEED,
+        wire_config=wire.WireConfig(),
+        chaos_spec=spec,
+        flight_dir=str(tmp_path),
+        supervisor_config=SupervisorConfig(
+            backoff_base_s=0.2, poll_s=0.05
+        ),
+    )
+    learner = SamplerLearner(
+        trainer,
+        FleetConfig(num_actors=2, idle_timeout_s=60),
+        num_shards=2,
+        shard_set=tier.shard_set,
+    )
+    engine = fleet_chaos.ChaosEngine(
+        faults, seed=SEED, num_actors=2, server=learner.server,
+        shard_tier=tier,
+    )
+    tier.start()
+    address = learner.start()
+    stop = threading.Event()
+
+    def actor_loop(actor_id):
+        # A wire-real synthetic actor: HELLO + streamed SEQS frames (the
+        # collect compile is not what this drill tests); param pushes are
+        # read and discarded.
+        rng = np.random.default_rng(100 + actor_id)
+        try:
+            sock = transport.connect(address, read_deadline_s=60)
+            packer = wire.TreePacker(wire.WireConfig())
+            send_frame(
+                sock,
+                K_HELLO,
+                pack_hello(
+                    {
+                        "actor_id": actor_id,
+                        **wire.negotiation_fields(wire.WireConfig()),
+                    }
+                ),
+            )
+            while recv_frame(sock)[0] != K_ACK:
+                pass
+            phase = 0
+            while not stop.is_set():
+                send_frame_parts(
+                    sock,
+                    K_SEQS,
+                    packer.pack(
+                        {
+                            "phase": phase,
+                            "param_version": 0,
+                            "env_steps_delta": 16.0,
+                            "ep_return_sum": -1.0,
+                            "ep_count": 1.0,
+                            "staged": synth_staged(rng),
+                        }
+                    ),
+                )
+                while recv_frame(sock)[0] != K_ACK:
+                    pass
+                phase += 1
+            sock.close()
+        except Exception:  # noqa: BLE001 — teardown cuts the socket
+            pass
+
+    threads = [
+        threading.Thread(target=actor_loop, args=(i,), daemon=True)
+        for i in range(2)
+    ]
+    logged = []
+    n0 = len(get_flight_recorder().events())
+    try:
+        for t in threads:
+            t.start()
+        state = learner.run(
+            N_TRAIN,
+            state=state,
+            log_every=2,
+            metrics_fn=lambda p, s: logged.append((p, dict(s))),
+            phase_fn=engine.on_phase,
+        )
+    finally:
+        stop.set()
+        learner.close()
+        for t in threads:
+            t.join(timeout=10)
+
+    # Run completed its exact schedule despite a shard dying mid-run.
+    assert int(state.train.step) == N_TRAIN * trainer.config.learner_steps
+    stats = learner.stats()
+    assert stats["train_phases"] == N_TRAIN
+    assert stats["sheds"] == 0  # zero sheds through the stall
+    assert stats["shard_deaths"] >= 1
+    assert engine.unfired() == ()  # kill + partition both landed
+    # Monotone counters through stall, partition, death, re-route.
+    env_steps = [s["env_steps"] for _, s in logged]
+    assert env_steps == sorted(env_steps) and env_steps[-1] > 0
+    evs = get_flight_recorder().events()[n0:]
+    kinds = [e["kind"] for e in evs]
+    assert "shard_dead" in kinds
+    assert "shard_quota_renorm" in kinds  # survivors re-quota'd on death
+    # Zero false reaps: nothing declared an actor or shard peer dead.
+    assert "peer_dead" not in kinds
+    # --- epoch-fenced rejoin: the killed proc's shard comes back under a
+    # bumped epoch and serves BOTH legs.
+    ss = tier.shard_set
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not ss.shards[0].alive:
+        ss.maybe_rejoin()
+        time.sleep(0.05)
+    try:
+        assert ss.shards[0].alive and ss.shards[0].epoch == 2
+        occ_before = ss.shards[0].occupancy
+        rng = np.random.default_rng(0)
+        ss.add(0, {"staged": synth_staged(rng), "actor_id": 0})
+        # Restarted shard serves the ingest leg (occupancy grew by B
+        # relative to whatever it re-absorbed since rejoin)...
+        assert ss.shards[0].occupancy == occ_before + 4
+        # ...and the sampler leg.
+        resp = ss.shards[0].sample(2, req_id=99)
+        assert resp["epoch"] == 2
+        # Stale-epoch PRIO against the new incarnation: ignored loudly.
+        stale = ss.shards[0].write_back(
+            resp["slots"], resp["gens"], np.ones(2, np.float32), epoch=1
+        )
+        assert stale["applied"] == 0 and stale["stale"]
+    finally:
+        tier.stop()
+    # The shard-side stall drill left durable evidence in its dump, and
+    # every scheduled shard-proc fault fired (the unfired contract).
+    assert (
+        fleet_chaos.shard_faults_unfired(
+            faults, str(tmp_path), seed=SEED, num_shard_procs=2
+        )
+        == ()
+    )
+    restarts = tier.restarts_total
+    assert restarts >= 1  # the supervisor's ladder did the rejoin

@@ -111,21 +111,21 @@ def test_resolve_names_the_four_stages():
 
 
 # ------------------------------------------------- composed off-settings
-def test_composed_off_settings_determinism_bit_identical(tmp_path):
+def test_composed_off_settings_determinism_bit_identical(
+    tmp_path, phase_locked_reference_k6
+):
     """--replay-shards 1 --learner-dp 1 --actors 0 == the untouched
     phase-locked Trainer.run, leaf-for-leaf bitwise, end to end through
     the train.py CLI — wiring ALL the composition knobs at their off
     settings changes no bit of the default schedule (the topology_gate
-    anchor)."""
+    anchor).  The reference half is the shared session fixture
+    (tests/conftest.py) — the pairing assert keeps it honest."""
     from r2d2dpg_tpu import train
     from r2d2dpg_tpu.utils import CheckpointManager
     from r2d2dpg_tpu.utils.checkpoint import resume_state
 
-    t1 = PENDULUM_TINY.build()
-    warm, fill = t1.window_fill_phases, t1.replay_fill_phases
-    s1 = t1.run(
-        warm + fill + N_TRAIN, log_every=LOG_EVERY, log_fn=lambda *_: None
-    )
+    assert (N_TRAIN, LOG_EVERY) == (6, 2)  # the k6 fixture's recipe
+    s1 = phase_locked_reference_k6
 
     train.run(
         train.parse_args(
@@ -134,6 +134,7 @@ def test_composed_off_settings_determinism_bit_identical(tmp_path):
                 "--actors", "0",
                 "--replay-shards", "1",
                 "--learner-dp", "1",
+                "--shard-procs", "0",  # ISSUE 12 off-setting rides too
                 "--phases", str(N_TRAIN),
                 "--log-every", str(LOG_EVERY),
                 "--checkpoint-dir", str(tmp_path / "ckpt"),

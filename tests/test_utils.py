@@ -340,21 +340,13 @@ def test_cli_rejects_unknown_config():
         parse_args(["--config", "nope"])
 
 
-def test_eval_cli_from_checkpoint(tmp_path):
-    """python -m r2d2dpg_tpu.eval: restore a checkpoint, score it."""
+def test_eval_cli_from_checkpoint(tiny_cli_checkpoint):
+    """python -m r2d2dpg_tpu.eval: restore a checkpoint, score it.  The
+    checkpoint is the shared read-only session fixture
+    (tests/conftest.py) — this test only restores from it."""
     from r2d2dpg_tpu.eval import main as eval_main
-    from r2d2dpg_tpu.train import main as train_main
 
-    ckdir = str(tmp_path / "ck")
-    train_main(
-        [
-            "--config", "pendulum_tiny",
-            "--phases", "2",
-            "--log-every", "0",
-            "--checkpoint-dir", ckdir,
-            "--checkpoint-every", "1",
-        ]
-    )
+    ckdir = tiny_cli_checkpoint
     out = eval_main(
         [
             "--config", "pendulum_tiny",
@@ -450,24 +442,22 @@ def test_restore_learner_raises_on_missing_leaves(tmp_path):
         _restore_learner(PENDULUM_TINY.build(), str(tmp_path / "ck"))
 
 
-def test_eval_cli_relative_checkpoint_dir(tmp_path, monkeypatch):
+def test_eval_cli_relative_checkpoint_dir(
+    tmp_path, monkeypatch, tiny_cli_checkpoint
+):
     """orbax requires absolute paths; the eval CLI must absolutize
 
     (regression: a relative --checkpoint-dir raised ValueError from orbax
-    while training with the same relative path worked)."""
+    while training with the same relative path worked).  The checkpoint's
+    provenance is irrelevant to the path-handling under test, so the
+    shared session checkpoint is COPIED under a relative name instead of
+    training a fresh identical one."""
+    import shutil
+
     from r2d2dpg_tpu.eval import main as eval_main
-    from r2d2dpg_tpu.train import main as train_main
 
     monkeypatch.chdir(tmp_path)
-    train_main(
-        [
-            "--config", "pendulum_tiny",
-            "--phases", "2",
-            "--log-every", "0",
-            "--checkpoint-dir", "ck",
-            "--checkpoint-every", "1",
-        ]
-    )
+    shutil.copytree(tiny_cli_checkpoint, tmp_path / "ck")
     out = eval_main(
         ["--config", "pendulum_tiny", "--checkpoint-dir", "ck",
          "--episodes", "2", "--rounds", "1"]

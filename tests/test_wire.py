@@ -582,20 +582,22 @@ def test_golden_sample_req_exact_bytes():
 
 
 def test_golden_prio_update_exact_bytes():
-    """PRIO: the write-back frame's byte layout — shard scalar, then
-    slots/gens (int64) and priorities (f32, PINNED on every lane)
-    depth-first in key order."""
+    """PRIO: the write-back frame's byte layout — shard and epoch scalars
+    (the shard-incarnation fence, ISSUE 12: a restarted shard ignores a
+    PRIO whose epoch is not its own), then slots/gens (int64) and
+    priorities (f32, PINNED on every lane) depth-first in key order."""
     slots, gens, _ = _sampler_handles()
     prios = np.array([0.5, 2.0, 8.0], np.float32)
     payload = b"".join(
         wire.pack_prio_update(
             TreePacker(WireConfig()), shard=1, slots=slots, gens=gens,
-            priorities=prios,
+            priorities=prios, epoch=4,
         )
     )
     schema = {
         "d": [
             ["shard", "i"],
+            ["epoch", "i"],
             ["slots", {"a": ["int64", "int64", [3]]}],
             ["gens", {"a": ["int64", "int64", [3]]}],
             ["priorities", {"a": ["float32", "float32", [3]]}],
@@ -604,6 +606,7 @@ def test_golden_prio_update_exact_bytes():
     sjson = json.dumps(schema, separators=(",", ":")).encode()
     body = (
         struct.pack("<q", 1)
+        + struct.pack("<q", 4)
         + slots.tobytes()
         + gens.tobytes()
         + prios.tobytes()
@@ -617,6 +620,7 @@ def test_golden_prio_update_exact_bytes():
     assert payload == want
     upd = wire.unpack_prio_update(TreeUnpacker().unpack(payload))
     np.testing.assert_array_equal(upd["priorities"], prios)
+    assert upd["epoch"] == 4
 
 
 @pytest.mark.parametrize("encoding", ["f32", "bf16"])
@@ -639,10 +643,11 @@ def test_shard_batch_frame_roundtrip_and_pinned_leaves(encoding):
             probs=probs,
             priority_sum=12.5,
             occupancy=3,
+            epoch=2,
         )
     )
     out = wire.unpack_shard_batch(TreeUnpacker().unpack(payload))
-    assert out["req_id"] == 9 and out["shard"] == 1
+    assert out["req_id"] == 9 and out["shard"] == 1 and out["epoch"] == 2
     assert out["priority_sum"] == 12.5 and out["occupancy"] == 3
     np.testing.assert_array_equal(out["slots"], slots)
     np.testing.assert_array_equal(out["gens"], gens)
@@ -675,6 +680,7 @@ def test_sampler_frame_validation_refuses_malformed():
             {
                 "req_id": 1,
                 "shard": 0,
+                "epoch": 0,
                 "priority_sum": 1.0,
                 "occupancy": 3,
                 "staged": _staged(b=2, priorities=False),  # 2 != 3 handles
@@ -689,6 +695,7 @@ def test_sampler_frame_validation_refuses_malformed():
         wire.unpack_prio_update(
             {
                 "shard": 0,
+                "epoch": 0,
                 "slots": slots,
                 "gens": gens[:2],
                 "priorities": np.ones(3, np.float32),
@@ -702,6 +709,7 @@ def test_sampler_frame_validation_refuses_malformed():
         wire.unpack_prio_update(
             {
                 "shard": 0,
+                "epoch": 0,
                 "slots": np.array([-1, 0, 1], np.int64),
                 "gens": gens,
                 "priorities": np.ones(3, np.float32),
@@ -712,6 +720,7 @@ def test_sampler_frame_validation_refuses_malformed():
             {
                 "req_id": 1,
                 "shard": 0,
+                "epoch": 0,
                 "priority_sum": 1.0,
                 "occupancy": 3,
                 "staged": _staged(b=3, priorities=False),
