@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -118,6 +119,71 @@ def _drain(proc) -> None:
     except subprocess.TimeoutExpired:
         proc.kill()
         proc.wait()
+
+
+def _drain_group(proc) -> None:
+    """SIGTERM-first teardown of a whole process GROUP (legs started with
+    ``start_new_session=True``).  The fleet legs' train.py spawns actor
+    and standalone shard subprocesses; signalling the leader alone
+    orphans them on the timeout path (a SIGTERMed leader never runs its
+    finally-block supervisor teardown, and a shard proc has no
+    learner-death exit of its own — it would keep listening on its
+    socket and stealing CPU from every later contention-sensitive leg).
+    The group signal reaches each member directly: shard procs dump
+    their flight ring on SIGTERM, actors just exit."""
+    try:
+        os.killpg(proc.pid, signal.SIGTERM)
+    except OSError:
+        proc.terminate()
+    try:
+        proc.wait(timeout=20)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
+        proc.wait()
+
+
+def _run_leg_cmd(cmd, env):
+    """subprocess.run(capture_output, timeout=900) equivalent for fleet
+    legs, with process-GROUP teardown on timeout (the spawned train.py
+    forks actor/shard subprocesses — see _drain_group).  Output spools
+    to temp FILES, not pipes: a pipe would deadlock a chatty child
+    (64 KiB buffer), and worse, a leader that dies abnormally leaves its
+    orphans holding the pipe open, so communicate() would block on a
+    DEAD leader until the full timeout.  Returns (returncode, stdout,
+    stderr); returncode None means the 900s budget expired and the whole
+    group was reaped."""
+    with tempfile.TemporaryFile(mode="w+") as out_f, tempfile.TemporaryFile(
+        mode="w+"
+    ) as err_f:
+        proc = subprocess.Popen(
+            cmd, env=env, cwd=HERE, stdout=out_f, stderr=err_f,
+            text=True, start_new_session=True,
+        )
+        timed_out = False
+        try:
+            proc.wait(timeout=900)
+        except subprocess.TimeoutExpired:
+            _drain_group(proc)
+            timed_out = True
+        if not timed_out and proc.returncode != 0:
+            # A leader that died WITHOUT running its finally-block
+            # teardown (SIGKILL/OOM/segfault) leaves its actor/shard
+            # subprocesses alive in the session; sweep the group
+            # best-effort.  Clean exits (rc 0) ran their own teardown —
+            # and their reaped pgid could already be recycled, so don't
+            # signal it.
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except OSError:
+                pass
+        out_f.seek(0)
+        err_f.seek(0)
+        stdout = out_f.read()
+        stderr = err_f.read()
+    return (None if timed_out else proc.returncode), stdout, stderr
 
 
 def _run_child(dtype: str | None, backend: str) -> tuple:
@@ -802,16 +868,12 @@ def _learner_dp_leg(dp: int, phases: int) -> dict:
         "--fleet-shed-after", "5", "--fleet-publish-every", "4",
         "--phases", str(phases), "--log-every", "0",
     ]
-    try:
-        out = subprocess.run(
-            cmd, env=env, cwd=HERE, capture_output=True, text=True,
-            timeout=900,
-        )
-    except subprocess.TimeoutExpired:
-        return {"error": "learner-dp leg exceeded 900s"}
-    stats = _parse_fleet_stats(out.stdout)
+    rc, stdout, stderr = _run_leg_cmd(cmd, env)
+    if rc is None:
+        return {"error": f"learner-dp leg exceeded 900s: {stderr[-300:]}"}
+    stats = _parse_fleet_stats(stdout)
     if not stats:
-        return {"error": f"rc={out.returncode}: {out.stderr[-300:]}"}
+        return {"error": f"rc={rc}: {stderr[-300:]}"}
     leg = {
         "learner_steps_per_sec": round(
             stats.get("train_learner_steps_per_sec", 0.0), 2
@@ -824,11 +886,11 @@ def _learner_dp_leg(dp: int, phases: int) -> dict:
             stats.get("learner_wait_p99_ms", 0.0), 1
         ),
     }
-    if out.returncode != 0:
+    if rc != 0:
         # The stats line printed but the child died in teardown (final
         # save, logger close): numbers are real, the run was NOT clean —
         # the record must say so, not mask it.
-        leg["error"] = f"rc={out.returncode}: {out.stderr[-300:]}"
+        leg["error"] = f"rc={rc}: {stderr[-300:]}"
     return leg
 
 
@@ -871,22 +933,18 @@ def _composed_leg(phases: int = 12) -> dict:
         "--fleet-publish-every", "4",
         "--phases", str(phases), "--log-every", "0",
     ]
-    try:
-        out = subprocess.run(
-            cmd, env=env, cwd=HERE, capture_output=True, text=True,
-            timeout=900,
-        )
-    except subprocess.TimeoutExpired:
-        return {"error": "composed leg exceeded 900s"}
-    stats = _parse_fleet_stats(out.stdout)
+    rc, stdout, stderr = _run_leg_cmd(cmd, env)
+    if rc is None:
+        return {"error": f"composed leg exceeded 900s: {stderr[-300:]}"}
+    stats = _parse_fleet_stats(stdout)
     lr_note = topo_note = ""
-    for line in out.stdout.splitlines():
+    for line in stdout.splitlines():
         if line.startswith("lr-scale-batch: "):
             lr_note = line[len("lr-scale-batch: "):]
         if line.startswith("topology: "):
             topo_note = line[len("topology: "):]
     if not stats:
-        return {"error": f"rc={out.returncode}: {out.stderr[-300:]}"}
+        return {"error": f"rc={rc}: {stderr[-300:]}"}
     leg = {
         "topology": topo_note,
         "lr_scale_batch": lr_note,  # the 1803.02811 co-scaling note
@@ -905,8 +963,8 @@ def _composed_leg(phases: int = 12) -> dict:
         "replay_occupancy": stats.get("replay_occupancy", 0.0),
         "overlap_fraction": round(stats.get("overlap_fraction", 0.0), 3),
     }
-    if out.returncode != 0:
-        leg["error"] = f"rc={out.returncode}: {out.stderr[-300:]}"
+    if rc != 0:
+        leg["error"] = f"rc={rc}: {stderr[-300:]}"
     return leg
 
 
@@ -933,9 +991,20 @@ def _shard_procs_leg(phases: int = 12) -> dict:
     container time-slices the learner, 3 actor processes and 2 shard
     processes, so rates are contention artifacts; the claims this leg
     records are sheds=0, run completion THROUGH a shard kill, and the
-    recovery latency."""
+    recovery latency.
+
+    ISSUE 13 additions: the run carries the full health plane
+    (``--obs-fleet`` TELEM from actors AND shard procs, ``--obs-port 0``
+    exporter) and the leg records the SCRAPE PATH's cost — /metrics GET
+    latency p50/p99 sampled ~5 Hz while every fleet process reports into
+    the one page — plus the end-of-run ``/health`` verdict
+    (health_final.json, stamped by train.py's fleet teardown).  On this
+    contended container a ``degraded``/``learner_starving`` verdict is an
+    HONEST answer (the wait p99 really is over threshold here), exactly
+    the signal the ROADMAP autoscaler would act on."""
     import json as _json
     import tempfile
+    import urllib.request
 
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -952,19 +1021,61 @@ def _shard_procs_leg(phases: int = 12) -> dict:
         # the delta is then socket/ack/advert overhead, not encoding.
         "--fleet-wire", "bf16", "--fleet-compress", "zlib",
         "--chaos-spec", f"kill_shard@p{max(phases // 2, 1)}",
+        "--obs-fleet", "1", "--obs-port", "0", "--obs-host", "127.0.0.1",
         "--phases", str(phases), "--log-every", "0",
         "--logdir", logdir,
     ]
-    try:
-        out = subprocess.run(
-            cmd, env=env, cwd=HERE, capture_output=True, text=True,
-            timeout=900,
+    # Pipes would deadlock a chatty child (64 KiB buffer); spool to files
+    # so the scrape loop below can run while the child trains.
+    out_path = os.path.join(logdir, "bench_stdout.log")
+    err_path = os.path.join(logdir, "bench_stderr.log")
+    scrape_lat = []
+    with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+        proc = subprocess.Popen(
+            cmd, env=env, cwd=HERE, stdout=out_f, stderr=err_f, text=True,
+            start_new_session=True,
         )
-    except subprocess.TimeoutExpired:
-        return {"error": "shard-procs leg exceeded 900s"}
-    stats = _parse_fleet_stats(out.stdout)
+        try:
+            deadline = time.monotonic() + 900
+            port = None
+            port_path = os.path.join(logdir, "obs_port.txt")
+            while proc.poll() is None and time.monotonic() < deadline:
+                if port is None:
+                    try:
+                        port = int(open(port_path).read().strip())
+                    except (OSError, ValueError):
+                        time.sleep(0.5)
+                        continue
+                t0 = time.monotonic()
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=5
+                    ).read()
+                    scrape_lat.append(time.monotonic() - t0)
+                except Exception:  # noqa: BLE001 — e.g. BadStatusLine on
+                    pass  # a teardown race; a failed scrape never counts
+                time.sleep(0.2)
+            if proc.poll() is None:
+                _drain_group(proc)
+                return {"error": "shard-procs leg exceeded 900s"}
+        finally:
+            # Whatever escapes the loop must not orphan the training
+            # child (and its actor/shard subprocesses); an abnormal exit
+            # (rc != 0: the leader's finally-block teardown may not have
+            # run) gets a best-effort group sweep too.
+            if proc.poll() is None:
+                _drain_group(proc)
+            elif proc.returncode != 0:
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except OSError:
+                    pass
+    rc = proc.returncode
+    stdout = open(out_path).read()
+    stderr = open(err_path).read()
+    stats = _parse_fleet_stats(stdout)
     if not stats:
-        return {"error": f"rc={out.returncode}: {out.stderr[-300:]}"}
+        return {"error": f"rc={rc}: {stderr[-300:]}"}
     # Recovery latency off the flight timeline: kill injection ->
     # shard_dead (+ the quota renorm recorded in the same breath).
     t_kill = t_dead = None
@@ -1006,8 +1117,26 @@ def _shard_procs_leg(phases: int = 12) -> dict:
             else None
         ),
     }
-    if out.returncode != 0:
-        leg["error"] = f"rc={out.returncode}: {out.stderr[-300:]}"
+    # Scrape-path overhead (ISSUE 13): /metrics latency with 3 actors +
+    # 2 shard procs all reporting into the one merged page.
+    if scrape_lat:
+        lat = sorted(scrape_lat)
+        leg["scrapes"] = len(lat)
+        leg["scrape_p50_ms"] = round(lat[len(lat) // 2] * 1e3, 2)
+        leg["scrape_p99_ms"] = round(lat[int(0.99 * (len(lat) - 1))] * 1e3, 2)
+    # End-of-run /health verdict: the autoscaler's input, stamped as
+    # bench evidence (train.py's fleet teardown writes the file).
+    try:
+        with open(os.path.join(logdir, "health_final.json")) as fh:
+            health = _json.load(fh)
+        leg["health_verdict"] = health.get("verdict")
+        leg["health_rules"] = sorted(
+            {f.get("rule") for f in health.get("findings", ())}
+        )
+    except (OSError, ValueError):
+        leg["health_verdict"] = None
+    if rc != 0:
+        leg["error"] = f"rc={rc}: {stderr[-300:]}"
     return leg
 
 

@@ -145,6 +145,7 @@ class IngestServer:
         warmup_deadline_s: float = 120.0,
         auth_token: Optional[str] = None,
         shards=None,
+        expected_actors: Optional[int] = None,
     ):
         self.queue = staging_queue
         # In-network sampling (fleet/sampler.py, ISSUE 10): when a
@@ -253,6 +254,16 @@ class IngestServer:
             "r2d2dpg_fleet_actors_connected", "live actor connections"
         )
         self._obs_connected.set_fn(lambda: float(len(self._conns)))
+        if expected_actors:
+            # The spawn TARGET on the scrape itself (ISSUE 13): the
+            # /health actors_down rule compares the supervisor's
+            # r2d2dpg_fleet_actors_alive against this, so the verdict
+            # needs no out-of-band config to know what "all actors up"
+            # means.
+            reg.gauge(
+                "r2d2dpg_fleet_actors_expected",
+                "the fleet's actor spawn target (--actors N)",
+            ).set(float(expected_actors))
         self._obs_peer_dead = reg.counter(
             "r2d2dpg_fleet_peer_dead_total",
             "connections reaped after a silent heartbeat deadline (the "
@@ -968,6 +979,7 @@ class FleetLearner:
             read_deadline_s=config.heartbeat_s,
             warmup_deadline_s=config.warmup_deadline_s,
             auth_token=config.auth_token,
+            expected_actors=config.num_actors,
         )
         drain_kwargs: Dict[str, Any] = {"donate_argnums": (0,)}
         ls_sh = getattr(trainer, "lstate_shardings", None)
@@ -1010,9 +1022,20 @@ class FleetLearner:
             "staged batches awaiting drain",
         )
         self._obs_queue_depth.set_fn(self.queue.qsize)
+        # Same split as the sampler's wait/absorb pair: absorb-phase
+        # queue waits are EXPECTED (actor spawn + jax import + collect
+        # compile — each Empty timeout lands a ~0.5s sample, right at the
+        # /health learner_starving threshold) and would read a clean
+        # warm-up as starving until ~window-size later waits flush them.
         self.learner_wait = reg.histogram(
             "r2d2dpg_fleet_learner_wait_seconds",
-            "learner thread blocked on the fleet staging queue (starvation)",
+            "learner thread blocked on the fleet staging queue AFTER "
+            "absorb (starvation — the /health learner_starving input)",
+        )
+        self.absorb_wait = reg.histogram(
+            "r2d2dpg_fleet_absorb_wait_seconds",
+            "learner thread blocked on the staging queue during the "
+            "absorb-to-min_replay phase (cold start and --resume re-entry)",
         )
         self._obs_coalesce = reg.gauge(
             "r2d2dpg_fleet_drain_coalesce_width",
@@ -1161,6 +1184,7 @@ class FleetLearner:
             time.monotonic() + minutes * 60 if minutes is not None else None
         )
         self.learner_wait.reset()
+        self.absorb_wait.reset()
         resume_from = resume_from or {}
         version = int(resume_from.get("param_version", 0)) + 1
         self.server.publish_params(version, self._snapshot_params(lstate))
@@ -1205,10 +1229,18 @@ class FleetLearner:
                 if deadline is not None and time.monotonic() >= deadline:
                     break
                 t_wait = time.monotonic()
+                # Absorb-phase waits go to their own histogram (see the
+                # registration comment): the learn-phase boundary is the
+                # same absorbed>min_seqs crossing the drain programs use.
+                wait_hist = (
+                    self.learner_wait
+                    if absorbed > min_seqs
+                    else self.absorb_wait
+                )
                 try:
                     first = self.queue.get(timeout=0.5)
                 except queue.Empty:
-                    self.learner_wait.add(time.monotonic() - t_wait)
+                    wait_hist.add(time.monotonic() - t_wait)
                     # Cold-start grace: the FIRST batch pays actor
                     # subprocess spawn + jax import + collect compile +
                     # window fill — give it double the steady-state bound.
@@ -1223,7 +1255,7 @@ class FleetLearner:
                             f"ones; check flight.jsonl)"
                         )
                     continue
-                self.learner_wait.add(time.monotonic() - t_wait)
+                wait_hist.add(time.monotonic() - t_wait)
                 last_batch_t = time.monotonic()
                 t_dequeue = time.time()
                 # Coalesced drain (drain_coalesce): the blocking-got batch
@@ -1458,6 +1490,7 @@ class FleetLearner:
                 self._warm_thread.join()
             wall = max(t_end - t0, 1e-9)
             _, lw_total, lw_p50, lw_p99 = self.learner_wait.snapshot()
+            _, aw_total, _, _ = self.absorb_wait.snapshot()
             srv = self.server
             # Rates are per-INCARNATION (phases this process ran over this
             # process's wall clock); the monotone totals live in counters().
@@ -1483,11 +1516,16 @@ class FleetLearner:
                 "learner_wait_p50_ms": lw_p50 * 1e3,
                 "learner_wait_p99_ms": lw_p99 * 1e3,
                 "learner_wait_total_s": lw_total,
+                "absorb_wait_s": aw_total,
                 # The pipelined executor's overlap instrumentation on the
                 # fleet schedule (ISSUE 11): fraction of the wall during
                 # which the learner had staged data available — same
                 # definition as PipelineExecutor.stats (1 - wait / wall).
-                "overlap_fraction": max(0.0, 1.0 - lw_total / wall),
+                # Absorb waits still count as un-overlapped here even
+                # though /health judges only the post-absorb histogram.
+                "overlap_fraction": max(
+                    0.0, 1.0 - (lw_total + aw_total) / wall
+                ),
                 # Wire accounting (docs/FLEET.md "Wire format"): frame
                 # bytes as received vs the declared decompressed size.
                 "bytes_in_total": float(srv.seqs_bytes_total),

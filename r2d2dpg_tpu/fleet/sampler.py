@@ -317,6 +317,7 @@ class SamplerLearner:
             warmup_deadline_s=config.warmup_deadline_s,
             auth_token=config.auth_token,
             shards=self.shards,
+            expected_actors=config.num_actors,
         )
         # Loopback frame codecs, one packer/unpacker pair per direction
         # (the sampler loop is the only caller — single-threaded).  The
@@ -349,13 +350,28 @@ class SamplerLearner:
             )
         self._learn_prog = jax.jit(self._learn_impl, **learn_kwargs)
         self._req_id = 0
+        self._phase_stall_s = 0.0  # per-pull dead-tier wait side channel
         self.sample_bytes_total = 0  # SAMPLE_REQ + BATCH + PRIO, with headers
         self.trained_seqs_total = 0
         reg = get_registry()
+        # Two DISTINCT waits, two histograms: the one-off cold-start /
+        # resume absorb (expected to take tens of seconds — compile +
+        # actor spawn) and mid-run pull stalls (a live-but-empty or dead
+        # shard tier).  Folding the absorb into the wait histogram made
+        # its p99 equal the absorb duration for the whole run, so the
+        # /health learner_starving rule read every sampler run as
+        # permanently starving off its single cold-start sample.
         self.sampler_wait = reg.histogram(
             "r2d2dpg_sampler_wait_seconds",
-            "sampler learner blocked waiting for shard occupancy "
-            "(absorb-to-min_replay and any refill stall)",
+            "seconds the pull loop stalled waiting for a live non-empty "
+            "shard, one sample PER PHASE (zeros included, so a past "
+            "outage decays out of the p99 — the /health learner_starving "
+            "input; cold-start absorb is r2d2dpg_sampler_absorb_seconds)",
+        )
+        self.sampler_absorb = reg.histogram(
+            "r2d2dpg_sampler_absorb_seconds",
+            "absorb-to-min_replay wait, one sample per incarnation "
+            "(cold start and --resume re-entry)",
         )
         self.sample_assemble = reg.histogram(
             "r2d2dpg_sampler_sample_seconds",
@@ -437,7 +453,9 @@ class SamplerLearner:
         self._obs_bytes.inc(n)
         return unpacker.unpack(payload)
 
-    def _pull_phase_batches(self, n_draws: int, rng: np.random.Generator):
+    def _pull_phase_batches(
+        self, n_draws: int, rng: np.random.Generator, tr=None
+    ):
         """One phase's two-level pull: quotas ∝ advertised Σp^α, one
         SAMPLE_REQ/BATCH exchange per non-empty shard, PRIO handles and
         combined probabilities assembled for the learn program.
@@ -445,9 +463,22 @@ class SamplerLearner:
         Returns ``(seq [n,...], probs [n], handles, occupancy_total)``
         with the concatenated draws PERMUTED (seeded) before the caller
         reshapes to ``[K, B]`` — quota counts are per shard, and without
-        the shuffle update k would correlate with shard identity."""
+        the shuffle update k would correlate with shard identity.
+
+        ``tr`` is the phase's sampled trace (ISSUE 13): on the remote
+        path its id rides each SAMPLE_REQ's 32B sidecar so the shard
+        procs stamp their own hops into the same trace; the loopback has
+        no process boundary to trace (the sampler chain covers it).
+
+        Side channel: ``self._phase_stall_s`` accumulates any dead-tier
+        wait this pull spent (remote path only; the loopback cannot
+        stall).  The caller observes it into ``sampler_wait`` ONCE PER
+        PHASE, zeros included — a rare 30s outage sample would otherwise
+        sit at the window's p99 indefinitely and keep /health reading a
+        long-recovered incident as starving-now."""
+        self._phase_stall_s = 0.0
         if self._remote:
-            return self._pull_phase_batches_remote(n_draws, rng)
+            return self._pull_phase_batches_remote(n_draws, rng, tr)
         sums = self.shards.scaled_sums()
         quotas = shard_quotas(sums, n_draws, rng)
         total = float(sums.sum())
@@ -511,7 +542,9 @@ class SamplerLearner:
             self.shards.occupancy_total(),
         )
 
-    def _pull_phase_batches_remote(self, n_draws: int, rng: np.random.Generator):
+    def _pull_phase_batches_remote(
+        self, n_draws: int, rng: np.random.Generator, tr=None
+    ):
         """The ``--shard-procs`` pull: same two-level math, real sockets,
         plus the graceful-degradation contract — a shard whose exchange
         fails mid-phase is marked dead, its quota redistributed over the
@@ -532,6 +565,7 @@ class SamplerLearner:
         epochs: List[np.ndarray] = []
         remaining = int(n_draws)
         deadline = time.monotonic() + self.config.idle_timeout_s
+        stall_t0: Optional[float] = None
         while remaining > 0:
             sums = shards.scaled_sums()
             total = float(sums.sum())
@@ -540,6 +574,8 @@ class SamplerLearner:
                 # WAITING (sampling stalls, training pauses, actors keep
                 # streaming into re-routed/absorbing shards) — never by
                 # fabricating draws.
+                if stall_t0 is None:
+                    stall_t0 = time.monotonic()
                 if time.monotonic() >= deadline:
                     raise RuntimeError(
                         "sampler starved: no live non-empty replay shard "
@@ -550,15 +586,30 @@ class SamplerLearner:
                 shards.maybe_rejoin()
                 time.sleep(0.1)
                 continue
+            if stall_t0 is not None:
+                # Banked into this PHASE's wait sample (see the caller):
+                # the mid-run learner-starving signal /health judges.
+                self._phase_stall_s += time.monotonic() - stall_t0
+                stall_t0 = None
             quotas = shard_quotas(sums, remaining, rng)
             remaining = 0
             for shard_id, quota in enumerate(quotas):
                 if quota == 0:
                     continue
                 self._req_id += 1
+                req_tr = None
+                if tr is not None:
+                    # A fresh stamp per REQ, sharing the phase's trace id:
+                    # the sidecar's collect-start slot carries the REQ's
+                    # birth time, and the packer stamps encode-end — the
+                    # shard's req_receive hop starts where that stamp
+                    # ends (obs/trace.py SHARD_HOPS).
+                    req_tr = obs_trace.TraceStamp(
+                        trace_id=tr.trace_id, t_collect_start=time.time()
+                    )
                 try:
                     resp = shards.shards[shard_id].sample(
-                        int(quota), self._req_id
+                        int(quota), self._req_id, trace=req_tr
                     )
                 except ShardUnavailableError as e:
                     # The mid-phase degradation moment: the dead shard's
@@ -721,6 +772,7 @@ class SamplerLearner:
             time.monotonic() + minutes * 60 if minutes is not None else None
         )
         self.sampler_wait.reset()
+        self.sampler_absorb.reset()
         self.sample_assemble.reset()
         resume_from = resume_from or {}
         version = int(resume_from.get("param_version", 0)) + 1
@@ -779,7 +831,7 @@ class SamplerLearner:
                         f"(check flight.jsonl)"
                     )
                 time.sleep(0.05)
-            self.sampler_wait.add(time.monotonic() - t_wait)
+            self.sampler_absorb.add(time.monotonic() - t_wait)
 
             while drained < num_train_phases:
                 if deadline is not None and time.monotonic() >= deadline:
@@ -789,10 +841,15 @@ class SamplerLearner:
                 t_req = time.time()
                 t_assemble = time.monotonic()
                 seq_np, probs_np, handles, occ = self._pull_phase_batches(
-                    n_draws, np_rng
+                    n_draws, np_rng, tr
                 )
                 t_batches = time.time()
                 self.sample_assemble.add(time.monotonic() - t_assemble)
+                # One wait sample per PHASE, zeros included (see the
+                # _pull_phase_batches docstring): stall-free phases
+                # dilute and eventually evict a past outage's sample, so
+                # the /health p99 answers "starving NOW", not "ever".
+                self.sampler_wait.add(self._phase_stall_s)
                 # [n] -> [K, B] for the compiled K-update scan, then
                 # mesh placement through the _put_staged hook on the
                 # BATCH axis (axis=1): under --learner-dp each dp slice
@@ -906,6 +963,7 @@ class SamplerLearner:
             fold_stats()
             wall = max(t_end - t0, 1e-9)
             _, sw_total, sw_p50, sw_p99 = self.sampler_wait.snapshot()
+            _, sa_total, _, _ = self.sampler_absorb.snapshot()
             srv = self.server
             drained_here = drained - drained_at_start
             trained = drained_here * n_draws
@@ -949,12 +1007,17 @@ class SamplerLearner:
                 "sampler_wait_p50_ms": sw_p50 * 1e3,
                 "sampler_wait_p99_ms": sw_p99 * 1e3,
                 "sampler_wait_total_s": sw_total,
+                "sampler_absorb_s": sa_total,
                 # The pipelined executor's overlap instrumentation,
                 # riding the composed loop (ISSUE 11): fraction of the
                 # wall during which the learner had sample data available
                 # (1.0 = collection fully hidden behind learning — same
                 # definition as PipelineExecutor.stats / FleetLearner).
-                "overlap_fraction": max(0.0, 1.0 - sw_total / wall),
+                # Absorb counts as un-overlapped wait here even though it
+                # lives in its own histogram for /health.
+                "overlap_fraction": max(
+                    0.0, 1.0 - (sw_total + sa_total) / wall
+                ),
             }
             if self._remote:
                 # The standalone tier's robustness ledger (ISSUE 12).
@@ -964,6 +1027,11 @@ class SamplerLearner:
                         "shard_rejoins": float(self.shards.rejoins_total),
                         "shard_forward_bytes_total": float(
                             self.shards.forward_bytes_total
+                        ),
+                        # Observability riders, apart from the sampling
+                        # boundary's wire-cost contract.
+                        "telem_bytes_total": float(
+                            self.shards.telem_bytes_total
                         ),
                     }
                 )

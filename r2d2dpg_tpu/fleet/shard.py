@@ -81,6 +81,7 @@ from r2d2dpg_tpu.fleet.transport import (
     K_PRIO,
     K_SAMPLE_REQ,
     K_SEQS,
+    K_TELEM,
     FrameError,
     PeerDeadError,
     hello_auth_proof,
@@ -92,7 +93,13 @@ from r2d2dpg_tpu.fleet.transport import (
     send_frame_parts,
     unpack_obj,
 )
-from r2d2dpg_tpu.obs import flight_event, get_registry, set_flight_identity
+from r2d2dpg_tpu.obs import (
+    flight_event,
+    get_registry,
+    get_remote_mirror,
+    set_flight_identity,
+)
+from r2d2dpg_tpu.obs import trace as obs_trace
 from r2d2dpg_tpu.replay.arena import StagedSequences
 from r2d2dpg_tpu.replay.sharded import ReplayShard
 from r2d2dpg_tpu.utils.codes import OK, REFUSED_AUTH, REFUSED_WIRE
@@ -113,6 +120,31 @@ class ShardUnavailableError(Exception):
     def __init__(self, msg: str, *, not_up: bool = False):
         super().__init__(msg)
         self.not_up = not_up
+
+
+# The learner-side fold's own instruments, excluded from TELEM pushes:
+# they account FOR this shard but belong to the receiving process (see
+# ShardServer._telem_snapshot).
+_TELEM_ECHO_EXCLUDE = frozenset(
+    {
+        "r2d2dpg_shard_telem_staleness_seconds",
+        "r2d2dpg_shard_telem_frames_total",
+    }
+)
+# Whole learner-owned metric families, same echo class: when server and
+# learner share one registry (in-process servers in tests, fused
+# topologies) the proc-wide slice would push frozen push-time copies of
+# e.g. the learner's wait histograms or the health gauges back under
+# shard= attribution — and a mirrored learner_wait sample that never
+# updates again would keep /health's learner_starving firing long after
+# the live series recovered.  A real shard proc never owns these names.
+_TELEM_ECHO_EXCLUDE_PREFIXES = (
+    "r2d2dpg_fleet_",  # ingest/actor-side accounting
+    "r2d2dpg_sampler_",  # sampler-learner instruments
+    "r2d2dpg_health_",  # verdict engine
+    "r2d2dpg_dp_",  # dp-learner gauges
+    "r2d2dpg_train_",  # trainer scalars
+)
 
 
 # ---------------------------------------------------------------- server
@@ -150,6 +182,8 @@ class ShardServer:
         read_deadline_s: float = READ_DEADLINE_S,
         auth_token: Optional[str] = None,
         chaos: Optional[fleet_chaos.ShardChaos] = None,
+        telem_every: float = 0.0,
+        telem_proc_wide: bool = True,
     ):
         self.shard = shard
         self.epoch = int(epoch)
@@ -159,6 +193,22 @@ class ShardServer:
         self.read_deadline_s = read_deadline_s
         self.auth_token = auth_token
         self.chaos = chaos
+        # Shard-proc telemetry (ISSUE 13 leg 1): ~1 Hz TELEM pushes of
+        # this process's registry snapshot (filtered to THIS shard's
+        # labelled series), riding the already-authenticated learner
+        # connections right after a reply — no extra socket, no extra
+        # thread, and a stalled shard's silence is itself the signal
+        # (the learner's per-shard staleness gauge keeps counting).
+        # 0 (the default) sends nothing: the loopback/byte anchors hold.
+        # telem_proc_wide: whether THIS server's pushes carry the
+        # registry's unlabelled process-wide series — exactly one server
+        # per process should (the proc's first shard), else a proc
+        # hosting M shards pushes M copies of every proc-wide series
+        # under M different shard= attributions.
+        self.telem_every = float(telem_every)
+        self.telem_proc_wide = bool(telem_proc_wide)
+        self._telem_last = 0.0
+        self._telem_lock = threading.Lock()
         # Within-shard draws are served by THIS incarnation's stream:
         # seeded per (seed, shard, epoch) so a restarted shard never
         # replays its predecessor's draw sequence against a fresh ring.
@@ -173,17 +223,47 @@ class ShardServer:
         self._conn_seq = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        sid = str(shard.shard_id)
         reg = get_registry()
+        # Shard-labelled (ISSUE 13): a proc hosting M/N shards must not
+        # conflate their counts into one cell — the labels are what the
+        # TELEM fold's per-shard snapshot filter keys on.
         self._obs_stale_prio = reg.counter(
             "r2d2dpg_shard_stale_epoch_prio_total",
             "PRIO write-back frames ignored because their epoch named a "
             "previous incarnation of this shard (the rejoin fence)",
-        )
+            labelnames=("shard",),
+        ).labels(shard=sid)
         self._obs_peer_dead = reg.counter(
             "r2d2dpg_shard_peer_dead_total",
             "shard-side connections reaped after a silent heartbeat "
             "deadline (the peer answered neither frames nor the PING)",
+            labelnames=("shard",),
+        ).labels(shard=sid)
+        # The ring internals, registered where the ring LIVES (set_fn:
+        # live at snapshot time, so each TELEM push carries the instant's
+        # truth, not a reply-paced copy).  Same names as the learner-side
+        # advert mirrors — where replay lives is deployment, not
+        # semantics; host= labels disambiguate in a merged scrape.
+        reg.gauge(
+            "r2d2dpg_replay_shard_priority_sum",
+            "raw priority sum of one replay shard (the quota weight is "
+            "sum p^alpha — ReplayShard.scaled_sum)",
+            labelnames=("shard",),
+        ).labels(shard=sid).set_fn(shard.priority_sum)
+        reg.gauge(
+            "r2d2dpg_replay_shard_occupancy",
+            "filled slots of one replay shard",
+            labelnames=("shard",),
+        ).labels(shard=sid).set_fn(shard.occupancy)
+        evict = reg.counter(
+            "r2d2dpg_replay_shard_evictions_total",
+            "filled replay-shard slots FIFO-overwritten by the ring "
+            "(re-collectable experience recycled before it was sampled)",
+            labelnames=("shard",),
         )
+        if shard._evict_cb is None:
+            shard._evict_cb = evict.labels(shard=sid).inc
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ShardServer":
@@ -289,6 +369,70 @@ class ShardServer:
             "evictions": s.evictions_total,
         }
 
+    def _telem_snapshot(self) -> Dict[str, dict]:
+        """This shard's slice of the process registry: samples carrying a
+        ``shard=`` label keep only THIS shard's cells (a proc hosts M/N
+        shards in one registry, and the learner's mirror merges its
+        ``shard=<id>`` attribution label OVER sample labels — an
+        unfiltered snapshot would relabel a sibling shard's series);
+        unlabelled process-wide instruments (trace hop histograms etc.)
+        ride along under this shard's attribution — from the proc's
+        ``telem_proc_wide`` server ONLY, so siblings sharing the
+        registry never push duplicate copies of one proc-wide series.
+
+        The fold's OWN accounting never rides: when server and learner
+        share a registry (in-process servers in tests, fused topologies)
+        the slice would otherwise echo the learner's staleness gauge
+        back at its push-time value, and the mirrored copy would shadow
+        the live series on the merged scrape — a recovered shard reading
+        permanently stale."""
+        sid = str(self.shard.shard_id)
+        out: Dict[str, dict] = {}
+        for name, entry in get_registry().snapshot().items():
+            if name in _TELEM_ECHO_EXCLUDE or name.startswith(
+                _TELEM_ECHO_EXCLUDE_PREFIXES
+            ):
+                continue
+            samples = []
+            for s in entry.get("samples", ()):
+                labels = s.get("labels")
+                if isinstance(labels, dict) and "shard" in labels:
+                    if labels["shard"] == sid:
+                        samples.append(s)
+                elif self.telem_proc_wide:
+                    samples.append(s)
+            if samples or entry.get("error"):
+                out[name] = {**entry, "samples": samples}
+        return out
+
+    def _maybe_send_telem(self, conn: socket.socket, force: bool = False):
+        """The ~1 Hz TELEM cadence rider, shard flavor: pushed right
+        after a reply on whichever authenticated connection is due first
+        (the learner's tolerant recv folds it before the next reply).
+        Fire-and-forget — no ack; send failures propagate into the
+        handler's normal torn-connection path."""
+        if self.telem_every <= 0.0:
+            return
+        now = time.monotonic()
+        with self._telem_lock:
+            if not force and now - self._telem_last < self.telem_every:
+                return
+            self._telem_last = now
+        send_frame(
+            conn,
+            K_TELEM,
+            pack_obj(  # wire-lint: control
+                {
+                    "shard": self.shard.shard_id,
+                    "epoch": self.epoch,
+                    "host": socket.gethostname(),
+                    "t_wall": time.time(),
+                    "snapshot": self._telem_snapshot(),
+                }
+            ),
+            max_frame_bytes=self.max_frame_bytes,
+        )
+
     def _handle(self, ident: int, conn: socket.socket) -> None:
         peer = "?"
         unpacker = wire.TreeUnpacker(max_frame_bytes=self.max_frame_bytes)
@@ -342,6 +486,9 @@ class ShardServer:
                 K_ACK,
                 pack_obj(self._advert()),  # wire-lint: control
             )
+            # Staleness is armed learner-side at HELLO; the forced push
+            # means the gauge arms WITH data, not against silence.
+            self._maybe_send_telem(conn, force=True)
             while not self._stop.is_set():
                 kind, payload = recv_frame_heartbeat(
                     conn, max_frame_bytes=self.max_frame_bytes
@@ -363,8 +510,17 @@ class ShardServer:
                         K_ACK,
                         pack_obj(self._advert()),  # wire-lint: control
                     )
+                    self._maybe_send_telem(conn)
                 elif kind == K_SAMPLE_REQ:
                     req = wire.unpack_sample_req(unpacker.unpack(payload))
+                    # Cross-boundary tracing (ISSUE 13 leg 2): a sampled
+                    # REQ's sidecar carries the trace id over the socket;
+                    # the shard stamps its own contiguous hop chain with
+                    # its own clock.  The REQ's encode-end stamp is read
+                    # BEFORE the reply pack below overwrites it in place.
+                    tr = unpacker.last_trace
+                    t_recv = time.time()
+                    t_req_encoded = tr.t_encode_end if tr is not None else 0.0
                     if req["shard"] != self.shard.shard_id:
                         raise FrameError(
                             f"SAMPLE_REQ for shard {req['shard']} on shard "
@@ -389,7 +545,9 @@ class ShardServer:
                                 {**self._advert(), "empty": True}
                             ),
                         )
+                        self._maybe_send_telem(conn)
                         continue
+                    t_draw_end = time.time()
                     self._gate()
                     send_frame_parts(
                         conn,
@@ -405,9 +563,34 @@ class ShardServer:
                             priority_sum=self.shard.scaled_sum(),
                             occupancy=self.shard.occupancy(),
                             epoch=self.epoch,
+                            trace=tr,
                         ),
                         max_frame_bytes=self.max_frame_bytes,
                     )
+                    if tr is not None:
+                        # All-or-nothing, AFTER the send: a torn exchange
+                        # leaves no partial chain (the sampler-chain
+                        # contract, obs/trace.py).  batch_encode spans
+                        # the chaos stall gate on purpose — a wedged
+                        # shard IS a fat batch_encode on the timeline.
+                        t_sent = time.time()
+                        attrs = {
+                            "shard": self.shard.shard_id,
+                            "epoch": self.epoch,
+                        }
+                        obs_trace.record_hop(
+                            "req_receive", t_req_encoded, t_recv,
+                            tr.trace_id, **attrs,
+                        )
+                        obs_trace.record_hop(
+                            "shard_draw", t_recv, t_draw_end,
+                            tr.trace_id, draws=int(req["quota"]), **attrs,
+                        )
+                        obs_trace.record_hop(
+                            "batch_encode", t_draw_end, t_sent,
+                            tr.trace_id, **attrs,
+                        )
+                    self._maybe_send_telem(conn)
                 elif kind == K_PRIO:
                     upd = wire.unpack_prio_update(unpacker.unpack(payload))
                     if upd["shard"] != self.shard.shard_id:
@@ -447,6 +630,7 @@ class ShardServer:
                             }
                         ),
                     )
+                    self._maybe_send_telem(conn)
                 else:
                     raise FrameError(f"unexpected frame kind {kind}")
         except PeerDeadError as e:
@@ -498,6 +682,9 @@ class RemoteShard:
         max_frame_bytes: int,
         read_deadline_s: float,
         on_bytes: Optional[Callable[[str, int], None]] = None,
+        on_telem: Optional[Callable[[bytes], None]] = None,
+        on_hello: Optional[Callable[[int], None]] = None,
+        on_telem_bytes: Optional[Callable[[int], None]] = None,
     ):
         self.shard_id = int(shard_id)
         self.address_fn = address_fn
@@ -506,6 +693,21 @@ class RemoteShard:
         self.max_frame_bytes = max_frame_bytes
         self.read_deadline_s = read_deadline_s
         self._on_bytes = on_bytes or (lambda leg, n: None)
+        # TELEM riders are observability traffic, never sampling-boundary
+        # cost: counted separately so sample_bytes_total keeps its
+        # SAMPLE_REQ + BATCH + PRIO (+acks/HELLO) contract and --obs-fleet
+        # cannot read as a wire regression in the bench byte comparisons.
+        self._on_telem_bytes = on_telem_bytes or (lambda n: None)
+        # Shard-proc TELEM (ISSUE 13): the server pushes registry
+        # snapshots right after replies, so any leg's recv can see a
+        # TELEM frame before the reply it is waiting for — ``_recv``
+        # folds them through ``on_telem`` (the owning set's mirror fold)
+        # and keeps reading.  ``on_hello`` fires with the incarnation's
+        # epoch after every successful HELLO: the set arms the per-shard
+        # staleness clock THERE, so a respawned incarnation's absorb
+        # phase never reads as wedged (the clock restarts with the epoch).
+        self._on_telem = on_telem
+        self._on_hello = on_hello or (lambda epoch: None)
         self.epoch = 0
         self.alive = True  # optimistic until a dial fails
         self.ever_connected = False  # first HELLO flips it (startup gate)
@@ -588,6 +790,7 @@ class RemoteShard:
             raise ShardUnavailableError(
                 f"shard {self.shard_id} HELLO failed: {e}"
             )
+        self._on_hello(self.epoch)
         self._legs[leg] = sock
         self.ever_connected = True
         # Wire state lives and dies with the socket — a reconnect gets
@@ -647,6 +850,30 @@ class RemoteShard:
             self._on_evictions(ev - self.evictions)
             self.evictions = ev
 
+    def _recv(self, leg: str, sock) -> Tuple[int, bytes]:
+        """One reply read that tolerates interleaved TELEM pushes: the
+        server sends its snapshot right after a reply, so the NEXT
+        exchange's first frame can be TELEM — fold it (guarded: a
+        malformed or raising fold must cost a flight event, never this
+        connection) and keep reading for the real reply.  PING/PONG is
+        already absorbed one layer down (recv_frame_heartbeat)."""
+        while True:
+            kind, payload = recv_frame_heartbeat(
+                sock, max_frame_bytes=self.max_frame_bytes
+            )
+            if kind != K_TELEM:
+                return kind, payload
+            self._on_telem_bytes(HEADER_BYTES + len(payload))
+            if self._on_telem is not None:
+                try:
+                    self._on_telem(payload)
+                except Exception as e:  # noqa: BLE001 - fold quarantine
+                    flight_event(
+                        "shard_telem_malformed",
+                        shard=self.shard_id,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+
     def _exchange(self, leg: str, do_exchange):
         """Run one send/recv exchange on a leg, re-dialing a torn
         connection once (at-least-once on the SEQS leg: a duplicate add
@@ -683,9 +910,7 @@ class RemoteShard:
                 max_frame_bytes=self.max_frame_bytes,
             )
             self._on_bytes("ingest", n)
-            kind, payload = recv_frame_heartbeat(
-                sock, max_frame_bytes=self.max_frame_bytes
-            )
+            kind, payload = self._recv("ingest", sock)
             self._on_bytes("ingest", HEADER_BYTES + len(payload))
             if kind != K_ACK:
                 raise FrameError(f"expected ACK, got kind {kind}")
@@ -696,7 +921,9 @@ class RemoteShard:
 
         return self._exchange("ingest", do)
 
-    def sample(self, quota: int, req_id: int) -> Optional[Dict[str, Any]]:
+    def sample(
+        self, quota: int, req_id: int, trace=None
+    ) -> Optional[Dict[str, Any]]:
         """Sampler leg: one SAMPLE_REQ/BATCH exchange.  The BATCH's epoch
         must match the connection's HELLO epoch — a mismatch is a stale
         in-flight batch from a previous incarnation and is dropped with a
@@ -704,7 +931,10 @@ class RemoteShard:
         ``None`` for an EMPTY shard (the server answers with an
         empty-marked advert ack instead of a BATCH — a stale quota weight
         routed draws at a live-but-fresh ring; the applied advert zeroes
-        its weight for the caller's redistribution)."""
+        its weight for the caller's redistribution).  ``trace`` (an
+        ``obs.trace.TraceStamp``) rides the REQ's 32B sidecar so the
+        shard process stamps its req_receive/shard_draw/batch_encode
+        hops into the same trace id (None = byte-identical frames)."""
 
         def do(sock, packer, unpacker):
             n = send_frame_parts(
@@ -715,13 +945,12 @@ class RemoteShard:
                     req_id=req_id,
                     shard=self.shard_id,
                     quota=int(quota),
+                    trace=trace,
                 ),
                 max_frame_bytes=self.max_frame_bytes,
             )
             self._on_bytes("sample", n)
-            kind, payload = recv_frame_heartbeat(
-                sock, max_frame_bytes=self.max_frame_bytes
-            )
+            kind, payload = self._recv("sample", sock)
             self._on_bytes("sample", HEADER_BYTES + len(payload))
             if kind == K_ACK:
                 ack = unpack_obj(payload)  # wire-lint: control
@@ -780,9 +1009,7 @@ class RemoteShard:
                 max_frame_bytes=self.max_frame_bytes,
             )
             self._on_bytes("sample", n)
-            kind, payload = recv_frame_heartbeat(
-                sock, max_frame_bytes=self.max_frame_bytes
-            )
+            kind, payload = self._recv("sample", sock)
             self._on_bytes("sample", HEADER_BYTES + len(payload))
             if kind != K_ACK:
                 raise FrameError(f"expected ACK, got kind {kind}")
@@ -841,6 +1068,7 @@ class RemoteShardSet:
         self._rejoin_lock = threading.Lock()
         self.sample_bytes_total = 0
         self.forward_bytes_total = 0
+        self.telem_bytes_total = 0  # observability riders, counted apart
         self.deaths_total = 0
         self.rejoins_total = 0
         self._on_sample_bytes: Callable[[int], None] = lambda n: None
@@ -863,6 +1091,32 @@ class RemoteShardSet:
             "quota renormalizations over surviving shards (one per shard "
             "death: the dead shard's advertised sum is zeroed, so every "
             "subsequent quota draw redistributes its share)",
+        )
+        # Shard-proc TELEM fold (ISSUE 13 leg 1): servers push registry
+        # snapshots over the authenticated legs; they land in the process
+        # RemoteMirror under shard=/host= labels so the learner's ONE
+        # /metrics scrape carries the shard procs' own series, with a
+        # per-shard staleness gauge armed at HELLO — a wedged or dead
+        # shard goes visibly STALE, never silently flat.  The clock is
+        # keyed (shard, epoch): a respawned incarnation restarts it at
+        # its HELLO, so its absorb phase never reads as wedged (the
+        # actor warm-up cadence fix, carried to the shard tier).
+        self._mirror = get_remote_mirror()
+        self._telem_lock = threading.Lock()
+        self._telem_last: Dict[Tuple[int, int], float] = {}
+        self._telem_epoch: Dict[int, int] = {}
+        self._obs_telem = reg.counter(
+            "r2d2dpg_shard_telem_frames_total",
+            "TELEM registry snapshots received from standalone shard "
+            "processes",
+            labelnames=("shard",),
+        )
+        self._obs_telem_staleness = reg.gauge(
+            "r2d2dpg_shard_telem_staleness_seconds",
+            "seconds since this shard's last TELEM snapshot under its "
+            "live epoch (a wedged or dead shard goes visibly stale; the "
+            "clock restarts at an epoch-bumped rejoin's HELLO)",
+            labelnames=("shard",),
         )
         # Same gauge names as the loopback set: where replay lives is
         # deployment, not semantics — one dashboard either way.
@@ -892,6 +1146,17 @@ class RemoteShardSet:
                 max_frame_bytes=max_frame_bytes,
                 read_deadline_s=read_deadline_s,
                 on_bytes=self._count_bytes,
+                on_telem_bytes=self._count_telem_bytes,
+                on_telem=(
+                    lambda payload, sid=i: self._fold_shard_telem(
+                        sid, payload
+                    )
+                ),
+                on_hello=(
+                    lambda epoch, sid=i: self._arm_telem_staleness(
+                        sid, epoch
+                    )
+                ),
             )
             for i in range(num_shards)
         ]
@@ -917,11 +1182,96 @@ class RemoteShardSet:
             with self._live_lock:
                 self.forward_bytes_total += n
 
+    def _count_telem_bytes(self, n: int) -> None:
+        # Kept OUT of sample/forward accounting: those carry wire-cost
+        # contracts (bench byte comparisons) that must not move when the
+        # operator turns the health plane on.
+        with self._live_lock:
+            self.telem_bytes_total += n
+
     def bind_sample_bytes(self, fn: Callable[[int], None]) -> None:
         """The sampler learner's byte counter rides every sampler-leg
         frame (REQ/BATCH/PRIO + acks, headers included) — the honest
         cross-process cost of the sampling boundary."""
         self._on_sample_bytes = fn
+
+    # -------------------------------------------------------------- telemetry
+    def _arm_telem_staleness(self, shard_id: int, epoch: int) -> None:
+        """Arm (or re-arm) one shard's staleness clock at HELLO.
+
+        The clock is keyed (shard, EPOCH): a bumped epoch is a fresh
+        incarnation, so its clock starts at ITS hello — the dead
+        incarnation's last-TELEM timestamp must never make a healthy
+        respawn read as minutes-stale while it absorbs (the same fix
+        class as PR 6's actor warm-up cadence).  Same incarnation
+        (partition heal, reconnect) keeps its clock: a wedge that
+        predates the re-dial stays visible."""
+        with self._telem_lock:
+            prev = self._telem_epoch.get(shard_id)
+            if prev != epoch:
+                self._telem_last.pop((shard_id, prev), None)
+                self._telem_epoch[shard_id] = epoch
+                self._telem_last[(shard_id, epoch)] = time.monotonic()
+            else:
+                self._telem_last.setdefault(
+                    (shard_id, epoch), time.monotonic()
+                )
+        self._obs_telem_staleness.labels(shard=str(shard_id)).set_fn(
+            lambda sid=shard_id: self._telem_staleness_s(sid)
+        )
+
+    def _telem_staleness_s(self, shard_id: int) -> float:
+        with self._telem_lock:
+            epoch = self._telem_epoch.get(shard_id)
+            t = self._telem_last.get((shard_id, epoch))
+        return 0.0 if t is None else time.monotonic() - t
+
+    def _fold_shard_telem(self, shard_id: int, payload: bytes) -> None:
+        """Fold one shard's TELEM push into the process RemoteMirror
+        under ``shard=``/``host=`` labels.
+
+        The shard identity comes from the CONNECTION (which socket the
+        frame arrived on), never the payload — a confused frame cannot
+        relabel another shard's series; a payload that contradicts its
+        connection is malformed.  Keyed ``shard:<id>`` in the mirror, so
+        a respawned incarnation UPDATES its slot (re-registration is
+        idempotent; the scrape never grows duplicate sources).  Raises
+        on malformed payloads — the caller (``RemoteShard._recv``) drops
+        them with a ``shard_telem_malformed`` flight event and the
+        connection keeps flowing."""
+        telem = unpack_obj(payload)  # wire-lint: control
+        if not isinstance(telem, dict):
+            raise ValueError("TELEM payload is not a dict")
+        snapshot = telem.get("snapshot")
+        if not isinstance(snapshot, dict):
+            raise ValueError("TELEM snapshot is not a dict")
+        claimed = telem.get("shard")
+        if claimed is not None and int(claimed) != int(shard_id):
+            raise ValueError(
+                f"TELEM claims shard {claimed} on shard {shard_id}'s "
+                f"connection"
+            )
+        labels = {"shard": str(shard_id)}
+        host = telem.get("host")
+        if host:
+            labels["host"] = str(host)
+        self._mirror.update(f"shard:{shard_id}", labels, snapshot)
+        epoch = telem.get("epoch")
+        with self._telem_lock:
+            if isinstance(epoch, int):
+                if self._telem_epoch.get(shard_id) != epoch:
+                    self._telem_last.pop(
+                        (shard_id, self._telem_epoch.get(shard_id)), None
+                    )
+                self._telem_epoch[shard_id] = epoch
+            epoch = self._telem_epoch.get(shard_id)
+            self._telem_last[(shard_id, epoch)] = time.monotonic()
+        # A fold re-arms the gauge too (idempotent overwrite): even a
+        # path that skipped HELLO arming still shows a live series.
+        self._obs_telem_staleness.labels(shard=str(shard_id)).set_fn(
+            lambda sid=shard_id: self._telem_staleness_s(sid)
+        )
+        self._obs_telem.labels(shard=str(shard_id)).inc()
 
     def close(self) -> None:
         self._stop.set()
@@ -1142,6 +1492,7 @@ class ShardProcTier:
         chaos_spec: Optional[str] = None,
         flight_dir: Optional[str] = None,
         supervisor_config=None,
+        telem_every: float = 0.0,
     ):
         if num_procs < 1:
             raise ValueError("num_procs must be >= 1")
@@ -1163,6 +1514,9 @@ class ShardProcTier:
         self.heartbeat_s = heartbeat_s
         self.chaos_spec = chaos_spec
         self.flight_dir = flight_dir
+        # Shard-proc TELEM cadence forwarded on argv (train.py passes 1.0
+        # under --obs-fleet, mirroring the actor spawner); 0 = off.
+        self.telem_every = float(telem_every)
         self._epochs: Dict[int, int] = {}
         self._sup_config = supervisor_config
         self.supervisor = None
@@ -1226,6 +1580,8 @@ class ShardProcTier:
         ]
         if self.chaos_spec:
             argv += ["--chaos-spec", self.chaos_spec]
+        if self.telem_every > 0.0:
+            argv += ["--telem-every", str(self.telem_every)]
         if self.flight_dir:
             argv += [
                 "--flight-path",
@@ -1322,6 +1678,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--chaos-spec", default=None,
                    help="seeded chaos schedule; this process fires the "
                    "stall_shard faults that target its --proc-index")
+    p.add_argument("--telem-every", type=float, default=0.0,
+                   help="seconds between TELEM registry-snapshot pushes "
+                   "to the learner over the authenticated shard legs "
+                   "(0 = off; train.py --obs-fleet spawns 1.0)")
     p.add_argument("--num-shard-procs", type=int, default=1)
     p.add_argument("--proc-index", type=int, default=0)
     p.add_argument("--flight-path", default=None,
@@ -1346,7 +1706,21 @@ def main(argv=None) -> None:
             # (fleet/actor.py's rule): dump beside it, never over it.
             root, ext = os.path.splitext(flight_path)
             flight_path = f"{root}.pid{os.getpid()}{ext}"
-        get_flight_recorder().install(flight_path)
+        # The span ring dumps as RAW JSONL (trace_shard<i>.jsonl) beside
+        # the flight dump: the shard-side trace hops (req_receive ->
+        # shard_draw -> batch_encode) merge into the fleet-wide Perfetto
+        # timeline via `obs.flight merge --trace-out` (ISSUE 13).  Same
+        # never-overwrite rule as the flight dump.
+        trace_path = os.path.join(
+            os.path.dirname(os.path.abspath(flight_path)),
+            f"trace_shard{args.proc_index}.jsonl",
+        )
+        if os.path.exists(trace_path):
+            troot, text_ = os.path.splitext(trace_path)
+            trace_path = f"{troot}.pid{os.getpid()}{text_}"
+        get_flight_recorder().install(
+            flight_path, trace_path=trace_path, trace_format="jsonl"
+        )
         signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
     try:
         wire_config = wire.WireConfig(
@@ -1386,6 +1760,12 @@ def main(argv=None) -> None:
                 read_deadline_s=args.read_deadline,
                 auth_token=auth_token,
                 chaos=chaos,
+                telem_every=args.telem_every,
+                # Unlabelled process-wide series ride exactly ONE
+                # shard's TELEM per proc: siblings share the registry,
+                # and each pushing its own copy would duplicate every
+                # proc-wide series under a different shard= attribution.
+                telem_proc_wide=(sid == shard_ids[0]),
             ).start()
         )
     if args.address_file:

@@ -508,13 +508,27 @@ def _rebuild(node: Any, body, cursor: List[int]) -> Any:
 
 
 def pack_sample_req(
-    packer: "TreePacker", *, req_id: int, shard: int, quota: int
+    packer: "TreePacker",
+    *,
+    req_id: int,
+    shard: int,
+    quota: int,
+    trace: Optional[TraceStamp] = None,
 ) -> List[Any]:
     """SAMPLE_REQ payload: the learner asks shard ``shard`` for ``quota``
     of this phase's draws (two-level level 1 — quotas are drawn from a
-    multinomial over the shards' advertised priority sums)."""
+    multinomial over the shards' advertised priority sums).
+
+    ``trace`` (ISSUE 13): a SAMPLED phase's stamp rides the same 32B
+    sidecar the SEQS path uses, carrying the trace id ACROSS the shard
+    socket so the shard process can stamp its ``req_receive ->
+    shard_draw -> batch_encode`` hops into the same trace.  ``None``
+    (the default, and the only value at trace rate 0) leaves the frame
+    byte-identical to the pre-sidecar layout — the golden-wire tests and
+    the loopback determinism anchor hold untouched."""
     return packer.pack(
-        {"req_id": int(req_id), "shard": int(shard), "quota": int(quota)}
+        {"req_id": int(req_id), "shard": int(shard), "quota": int(quota)},
+        trace=trace,
     )
 
 
@@ -541,6 +555,7 @@ def pack_shard_batch(
     priority_sum: float,
     occupancy: int,
     epoch: int = 0,
+    trace: Optional[TraceStamp] = None,
 ) -> List[Any]:
     """BATCH payload: a shard's training-ready answer.  ``slots``/``gens``
     are the write-back handles (PRIO frames echo them; a generation the
@@ -560,7 +575,12 @@ def pack_shard_batch(
     epoch, so handles sampled from the previous incarnation can never
     clobber the new ring (slot generations restart at zero and WOULD
     collide without the fence).  The in-learner loopback has exactly one
-    incarnation and packs the constant 0."""
+    incarnation and packs the constant 0.
+
+    ``trace`` echoes a traced SAMPLE_REQ's sidecar back on the BATCH
+    (the packer stamps ``t_encode_end`` with the shard's encode end):
+    the id correlates the reply with the learner-side chain, and
+    unsampled frames stay byte-identical (the rate-0 anchor)."""
     return packer.pack(
         {
             "req_id": int(req_id),
@@ -572,7 +592,8 @@ def pack_shard_batch(
             "gens": np.ascontiguousarray(gens, np.int64),
             "probs": np.ascontiguousarray(probs, np.float64),
             "staged": staged,
-        }
+        },
+        trace=trace,
     )
 
 
@@ -625,6 +646,7 @@ def pack_prio_update(
     gens: np.ndarray,
     priorities: np.ndarray,
     epoch: int = 0,
+    trace: Optional[TraceStamp] = None,
 ) -> List[Any]:
     """PRIO payload: learner TD-error write-back, keyed (shard, slot,
     generation) — the reverse ride of the versioned param-publish path.
@@ -633,7 +655,9 @@ def pack_prio_update(
     came from (``pack_shard_batch``): a standalone shard ignores a PRIO
     whose epoch is not its own — a verdict about a previous incarnation's
     ring must never touch the restarted one (slot generations restart at
-    zero, so without the fence stale handles would falsely match)."""
+    zero, so without the fence stale handles would falsely match).
+    ``trace`` (ISSUE 13): the same optional sidecar ride as the other
+    sampler frames — None leaves the bytes untouched."""
     return packer.pack(
         {
             "shard": int(shard),
@@ -641,7 +665,8 @@ def pack_prio_update(
             "slots": np.ascontiguousarray(slots, np.int64),
             "gens": np.ascontiguousarray(gens, np.int64),
             "priorities": np.ascontiguousarray(priorities, np.float32),
-        }
+        },
+        trace=trace,
     )
 
 

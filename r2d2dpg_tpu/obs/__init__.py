@@ -6,7 +6,11 @@ One process-wide namespace for every subsystem's operator signals:
   sets (``get_registry()`` is the process singleton all subsystems
   register into).
 - ``exporter``  — stdlib-HTTP scrape point (``/metrics`` Prometheus text,
-  ``/metrics.json`` snapshot) on ``--obs-port``.
+  ``/metrics.json`` snapshot, ``/health`` verdict JSON) on ``--obs-port``.
+- ``health``    — the /health rule engine (ISSUE 13): machine-readable
+  ``{verdict, findings[]}`` over registry+mirror signals, verdict
+  transitions recorded as flight events — the autoscaler's input
+  contract, built as observability.
 - ``flight``    — bounded ring of structured events dumped to
   ``flight.jsonl`` on exit/abort (``flight_event(kind, **fields)``).
 - ``watchdog``  — NaN/Inf + grad/param-norm checks riding the log
@@ -34,6 +38,10 @@ from r2d2dpg_tpu.obs.flight import (
     get_flight_recorder,
     set_flight_identity,
 )
+from r2d2dpg_tpu.obs.health import (
+    HealthConfig,
+    HealthEngine,
+)
 from r2d2dpg_tpu.obs.registry import (
     Counter,
     Gauge,
@@ -59,6 +67,8 @@ __all__ = [
     "DivergenceWatchdog",
     "FlightRecorder",
     "Gauge",
+    "HealthConfig",
+    "HealthEngine",
     "Histogram",
     "MetricsExporter",
     "Registry",

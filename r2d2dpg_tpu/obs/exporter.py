@@ -9,6 +9,14 @@ is live):
                           ``curl`` at it.
 - ``GET /metrics.json``   the registry's typed JSON snapshot.
 - ``GET /healthz``        ``ok`` (liveness only).
+- ``GET /health``         the VERDICT endpoint (ISSUE 13): a
+                          ``HealthEngine`` rule pass over the merged
+                          registry+mirror signals returning
+                          ``{verdict, findings[]}`` JSON — liveness says
+                          "the exporter thread runs", the verdict says
+                          "the topology is healthy".  Always HTTP 200
+                          (a degraded run is an ANSWER, not a transport
+                          error); the verdict field is the contract.
 
 One scrape point per FLEET (ISSUE 6): the exporter also merges a
 ``RemoteMirror`` — other processes' registry snapshots, fed by the fleet
@@ -38,6 +46,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from r2d2dpg_tpu.obs.health import HealthEngine
 from r2d2dpg_tpu.obs.registry import (
     Registry,
     RemoteMirror,
@@ -50,7 +59,18 @@ from r2d2dpg_tpu.obs.registry import (
 
 class MetricsExporter:
     """Serve one registry (+ optional remote mirror) over HTTP until
-    ``stop()`` (or process exit)."""
+    ``stop()`` (or process exit).
+
+    ``health`` is the /health verdict engine; a caller that learns its
+    topology AFTER the exporter starts (train.py resolves
+    --actors/--shard-procs later) re-arms it with thresholds and
+    expected process counts via ``arm_health()`` — a GET with no engine
+    armed lazily builds a default one over this exporter's
+    registry+mirror.  Both paths share one lock: the server is already
+    serving when the caller arms, and an unguarded lazy default could
+    otherwise win a check-then-act race and silently replace the
+    configured engine (default thresholds disarm actors_down/
+    shards_down) for the rest of the run."""
 
     def __init__(
         self,
@@ -58,9 +78,12 @@ class MetricsExporter:
         port: int = 0,
         host: str = "0.0.0.0",
         mirror: Optional[RemoteMirror] = None,
+        health: Optional[HealthEngine] = None,
     ):
         self.registry = registry
         self.mirror = mirror
+        self.health = health
+        self._health_lock = threading.Lock()
         exporter = self
 
         def merged_snapshot():
@@ -81,6 +104,26 @@ class MetricsExporter:
                     elif path in ("/metrics.json", "/snapshot"):
                         body = json.dumps(
                             merged_snapshot(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                    elif path == "/health":
+                        engine = exporter.health
+                        if engine is None:
+                            # Lazy default: verdicts over whatever this
+                            # process's registry+mirror already carry
+                            # (thresholds at HealthConfig defaults).
+                            # Re-checked under the arm_health lock so a
+                            # concurrently-armed configured engine is
+                            # never replaced by the default.
+                            with exporter._health_lock:
+                                if exporter.health is None:
+                                    exporter.health = HealthEngine(
+                                        registry=exporter.registry,
+                                        mirror=exporter.mirror,
+                                    )
+                                engine = exporter.health
+                        body = json.dumps(
+                            engine.evaluate(), default=str
                         ).encode()
                         ctype = "application/json"
                     elif path == "/healthz":
@@ -116,6 +159,13 @@ class MetricsExporter:
             daemon=True,
         )
         self._thread.start()
+
+    def arm_health(self, engine: HealthEngine) -> HealthEngine:
+        """Install the configured verdict engine (lock-shared with the
+        /health handler's lazy default, which must never outrace it)."""
+        with self._health_lock:
+            self.health = engine
+        return engine
 
     def stop(self) -> None:
         self._server.shutdown()

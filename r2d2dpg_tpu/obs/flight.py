@@ -33,7 +33,14 @@ loads straight into chrome://tracing.
 ``flight*.jsonl``; ``python -m r2d2dpg_tpu.obs.flight merge <dir|file>...``
 concatenates them sorted by ``t_wall`` into one attributable timeline
 (the identity stamps say who recorded each line).  The trace dumper
-reuses the same sort.
+reuses the same sort.  A run DIRECTORY auto-discovers every dump the
+run left behind — the learner's ``flight.jsonl``, per-actor
+``flight_actor<i>.jsonl``, per-shard-proc ``flight_shard<i>.jsonl``,
+AND the span dumps (the learner's Chrome-format ``trace.json`` plus the
+shard procs' ``trace_shard<i>.jsonl`` span rings) — and ``--trace-out``
+folds every discovered span source into ONE Perfetto timeline spanning
+learner + actors + shard procs (ISSUE 13), each span keeping a ``file``
+source stamp on top of its identity fields.
 
 Hard crashes (SIGSEGV & friends) cannot run Python: ``install()`` also
 points ``faulthandler`` at a sidecar ``<path>.fault`` file so native
@@ -104,6 +111,7 @@ class FlightRecorder:
         self._seq = 0
         self._installed_path: Optional[str] = None
         self._trace_path: Optional[str] = None
+        self._trace_format = "chrome"
         self._fault_file = None
         self._identity: Dict[str, object] = {}
 
@@ -186,33 +194,78 @@ class FlightRecorder:
         return path
 
     def dump_trace(self, path: Optional[str] = None) -> Optional[str]:
-        """Write the span ring as Chrome-trace JSON (atomic).  Returns the
+        """Write the span ring (atomic): Chrome-trace JSON by default, or
+        raw span JSONL when the recorder was installed with
+        ``trace_format="jsonl"`` (shard processes — the merge CLI folds
+        those lines into the fleet-wide Perfetto timeline).  Returns the
         path, or None when no path is known OR no spans were recorded — an
-        untraced run never litters an empty trace.json."""
+        untraced run never litters an empty trace file."""
         path = path or self._trace_path
         spans = self.spans()
         if path is None or not spans:
             return None
-        _atomic_write(path, json.dumps(chrome_trace(spans), default=str))
+        if self._trace_format == "jsonl":
+            _atomic_write(
+                path,
+                "".join(json.dumps(s, default=str) + "\n" for s in spans),
+            )
+        else:
+            _atomic_write(path, json.dumps(chrome_trace(spans), default=str))
         return path
 
     # --------------------------------------------------------------- install
-    def install(self, path: str) -> None:
+    def install(
+        self,
+        path: str,
+        *,
+        trace_path: Optional[str] = None,
+        trace_format: str = "chrome",
+    ) -> None:
         """Arm exit-time capture: dump to ``path`` at interpreter exit,
-        spans to ``trace.json`` next to it, and route hard-crash native
+        spans to ``trace_path`` (default: ``path``'s name with its
+        ``flight`` prefix swapped for ``trace`` — ``flight.jsonl`` keeps
+        the documented ``trace.json``, ``flight_actor0.jsonl`` gets its
+        own ``trace_actor0.json``), and route hard-crash native
         tracebacks to ``<path>.fault``.
+
+        ``trace_format="jsonl"`` dumps RAW span lines instead of a
+        Chrome-trace document — the shard-process shape (ISSUE 13):
+        per-proc ``trace_shard<i>.jsonl`` rings that the merge CLI folds
+        into one fleet timeline (a per-proc Chrome doc would need parsing
+        back apart to merge).
 
         Idempotent per path; re-installing with a new path re-targets the
         dump (one atexit hook either way).  Watchdog/abort paths call
         ``dump()``/``dump_trace()`` explicitly — atexit is the safety net,
         not the contract.
         """
+        if trace_format not in ("chrome", "jsonl"):
+            raise ValueError(f"unknown trace_format {trace_format!r}")
+        if trace_path is None:
+            # Default derives from the FLIGHT dump's name, so every
+            # process in a run dir gets its own span dump: flight.jsonl
+            # -> trace.json (the learner, the documented name), but
+            # flight_actor0.jsonl -> trace_actor0.json — N actors all
+            # defaulting to one shared trace.json would last-exiter-wins
+            # clobber each other, and the merged --trace-out timeline
+            # would silently hold one process's spans.
+            base = os.path.basename(path)
+            root = base[: -len(".jsonl")] if base.endswith(".jsonl") else (
+                os.path.splitext(base)[0]
+            )
+            tname = (
+                "trace" + root[len("flight"):]
+                if root.startswith("flight")
+                else f"trace_{root}"
+            ) + ".json"
+            trace_path = os.path.join(
+                os.path.dirname(os.path.abspath(path)), tname
+            )
         with self._lock:
             first = self._installed_path is None
             self._installed_path = path
-            self._trace_path = os.path.join(
-                os.path.dirname(os.path.abspath(path)), "trace.json"
-            )
+            self._trace_path = trace_path
+            self._trace_format = trace_format
         if first:
             atexit.register(self._atexit_dump)
         # faulthandler can't run Python on SIGSEGV; give it a sidecar file
@@ -267,8 +320,11 @@ def set_flight_identity(**fields) -> None:
 # ----------------------------------------------------------------- merge CLI
 def expand_flight_paths(paths: Iterable[str]) -> List[str]:
     """Resolve the merge CLI's arguments: files pass through, directories
-    expand to their ``flight*.jsonl`` dumps (a fleet logdir holds the
-    learner's ``flight.jsonl`` plus one ``flight_actorN.jsonl`` each)."""
+    expand to their ``flight*.jsonl`` dumps — the learner's
+    ``flight.jsonl``, per-actor ``flight_actorN.jsonl``, and per-shard-
+    proc ``flight_shardN.jsonl`` all match one pattern, so a run DIR is
+    a complete argument on its own (ISSUE 13 satellite: no more
+    enumerating files by hand)."""
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -276,6 +332,124 @@ def expand_flight_paths(paths: Iterable[str]) -> List[str]:
         else:
             out.append(p)
     return out
+
+
+def expand_trace_paths(paths: Iterable[str]) -> List[str]:
+    """The span-source half of run-dir discovery: directories expand to
+    their ``trace*.jsonl`` span dumps (shard procs) AND ``trace*.json``
+    Chrome documents (the learner's dump_trace artifact); explicit files
+    pass through.  Both formats feed ``load_spans``."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(
+                sorted(
+                    glob.glob(os.path.join(p, "trace*.jsonl"))
+                    + glob.glob(os.path.join(p, "trace*.json"))
+                )
+            )
+        else:
+            out.append(p)
+    return out
+
+
+# Top-level marker stamped into every --trace-out document (Perfetto
+# ignores unknown keys): distinguishes a previous merge output — safe to
+# exclude from span discovery and overwrite on a re-run — from a process's
+# real span dump, which must never be silently clobbered.
+_FUSED_KEY = "fusedBy"
+
+
+def _is_trace_arg(path: str) -> bool:
+    """Classify a non-directory merge argument by NAME: ``trace*.jsonl``
+    and ``trace*.json`` are span dumps for the ``--trace-out`` fuse, never
+    event-timeline sources — a span line parses as a valid JSON dict (it
+    even carries ``t_wall``), so feeding one to ``merge_flight_files``
+    would silently interleave bogus no-``kind`` events into the fleet
+    timeline instead of failing."""
+    name = os.path.basename(path)
+    return name.startswith("trace") and (
+        name.endswith(".jsonl") or name.endswith(".json")
+    )
+
+
+def _span_from_chrome_event(e: Dict) -> Optional[Dict]:
+    """Invert ``chrome_trace``'s event shape back into a raw span so an
+    already-rendered learner ``trace.json`` merges with the shard procs'
+    raw ``trace_shard*.jsonl`` rings on equal footing."""
+    if not isinstance(e, dict) or e.get("ph") != "X":
+        return None
+    args = e.get("args") if isinstance(e.get("args"), dict) else {}
+    try:
+        span = {
+            "hop": str(e.get("name", "span")),
+            "trace_id": int(args.get("trace_id", e.get("tid", 0))),
+            "t_wall": float(e.get("ts", 0.0)) / 1e6,
+            "dur_s": float(e.get("dur", 0.0)) / 1e6,
+            "pid": int(e.get("pid", 0)),
+        }
+    except (TypeError, ValueError):
+        # A non-numeric ts/dur/tid (truncated, foreign, or version-skewed
+        # dump) is one bad EVENT: None -> the caller's skipped tally,
+        # like any other unparseable line — never a merge-wide traceback.
+        return None
+    span.update({k: v for k, v in args.items() if k != "trace_id"})
+    return span
+
+
+def load_spans(paths: Iterable[str]) -> Tuple[List[Dict], int]:
+    """N span dumps (raw ``.jsonl`` lines and/or Chrome ``.json``
+    documents) -> one span list + the count of unparseable lines/events.
+    Every span gets a ``file`` source stamp (preserved over a merge, like
+    the event timeline's), so the fused Perfetto view still says which
+    process recorded each hop."""
+    spans: List[Dict] = []
+    skipped = 0
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                content = f.read()
+        except OSError:
+            skipped += 1
+            continue
+        if path.endswith(".jsonl"):
+            for line in content.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    s = json.loads(line)
+                except ValueError:
+                    skipped += 1
+                    continue
+                if isinstance(s, dict) and "hop" in s:
+                    s.setdefault("file", name)
+                    spans.append(s)
+                else:
+                    skipped += 1
+        else:
+            try:
+                doc = json.loads(content)
+            except ValueError:
+                skipped += 1
+                continue
+            if isinstance(doc, dict) and _FUSED_KEY in doc:
+                # A previous merge output (any name): derived data, never
+                # a source — re-ingesting it would duplicate every span
+                # it fused, N+1 copies after N re-runs into one run dir.
+                continue
+            events = (
+                doc.get("traceEvents", ()) if isinstance(doc, dict) else ()
+            )
+            for e in events:
+                s = _span_from_chrome_event(e)
+                if s is None:
+                    skipped += 1
+                    continue
+                s.setdefault("file", name)
+                spans.append(s)
+    return sort_by_twall(spans), skipped
 
 
 def merge_flight_files(paths: Iterable[str]) -> Tuple[List[Dict], int]:
@@ -325,30 +499,112 @@ def main(argv=None) -> None:
     )
     m.add_argument(
         "paths", nargs="+",
-        help="flight .jsonl files and/or run dirs (dirs expand to their "
-        "flight*.jsonl dumps)",
+        help="flight .jsonl files, trace*.jsonl/trace*.json span dumps "
+        "(--trace-out sources), and/or run dirs (dirs expand to both "
+        "kinds)",
     )
     m.add_argument(
         "-o", "--out", default=None,
         help="write the merged JSONL here (default: stdout)",
     )
+    m.add_argument(
+        "--trace-out", default=None, metavar="TRACE_JSON",
+        help="also fuse every discovered span dump (the learner's "
+        "trace.json + the shard procs' trace_shard*.jsonl rings) into "
+        "ONE Perfetto/chrome://tracing timeline at this path",
+    )
     args = p.parse_args(argv)
-    paths = expand_flight_paths(args.paths)
-    if not paths:
-        raise SystemExit("flight merge: no flight*.jsonl files found")
-    merged, skipped = merge_flight_files(paths)
-    body = "".join(json.dumps(e, default=str) + "\n" for e in merged)
-    skip_note = f" ({skipped} unparseable lines skipped)" if skipped else ""
-    if args.out:
-        _atomic_write(args.out, body)
-        sys.stderr.write(
-            f"flight merge: {len(merged)} events from {len(paths)} files"
-            f"{skip_note} -> {args.out}\n"
+    # Explicit trace* file args are span sources, never timeline sources
+    # (see _is_trace_arg); naming one without --trace-out is a request
+    # the event merge cannot honor — refuse instead of ignoring it.
+    span_args = [
+        q for q in args.paths if not os.path.isdir(q) and _is_trace_arg(q)
+    ]
+    if span_args and not args.trace_out:
+        raise SystemExit(
+            "flight merge: trace dump args "
+            f"({', '.join(os.path.basename(q) for q in span_args)}) are "
+            "span sources — pass --trace-out to fuse them"
         )
-    else:
-        sys.stdout.write(body)
-        if skip_note:
-            sys.stderr.write(f"flight merge:{skip_note}\n")
+    paths = expand_flight_paths(
+        [q for q in args.paths if q not in span_args]
+    )
+    if not paths and not args.trace_out:
+        raise SystemExit("flight merge: no flight*.jsonl files found")
+    # The event merge runs only when its product goes somewhere: -o, or
+    # stdout when events are the REQUESTED product (a --trace-out run
+    # without -o is asking for the timeline, and merging megabytes of
+    # flight lines to discard them would be pure waste).
+    if paths and (args.out or args.trace_out is None):
+        merged, skipped = merge_flight_files(paths)
+        body = "".join(json.dumps(e, default=str) + "\n" for e in merged)
+        skip_note = (
+            f" ({skipped} unparseable lines skipped)" if skipped else ""
+        )
+        if args.out:
+            _atomic_write(args.out, body)
+            sys.stderr.write(
+                f"flight merge: {len(merged)} events from {len(paths)} files"
+                f"{skip_note} -> {args.out}\n"
+            )
+        else:
+            sys.stdout.write(body)
+            if skip_note:
+                sys.stderr.write(f"flight merge:{skip_note}\n")
+    if args.trace_out:
+        # Span sources: every directory arg's trace*.jsonl / trace*.json
+        # dumps plus the explicitly-named ones — minus the --trace-out
+        # target itself (writing the fused doc INTO a scanned run dir is
+        # natural, and a re-run would otherwise re-ingest the previous
+        # output and duplicate every span).  The exclusion is only safe
+        # when the target IS a previous fused output (the _FUSED_KEY
+        # marker below): an existing trace* file WITHOUT the marker is a
+        # real span dump (e.g. the learner's trace.json), and
+        # exclude+overwrite would drop its spans from the fusion AND
+        # destroy them on disk — refuse instead.
+        out_abs = os.path.abspath(args.trace_out)
+        if os.path.isfile(out_abs) and _is_trace_arg(out_abs):
+            try:
+                with open(out_abs) as f:
+                    prev = json.load(f)
+                prev_fused = isinstance(prev, dict) and _FUSED_KEY in prev
+            except (OSError, ValueError):
+                prev_fused = False
+            if not prev_fused:
+                raise SystemExit(
+                    f"flight merge: --trace-out {args.trace_out} would "
+                    "overwrite an existing span dump (not a previous "
+                    "merge output) — pick a different output name"
+                )
+        trace_paths = []
+        seen_abs = {out_abs}
+        for q in (
+            expand_trace_paths([q for q in args.paths if os.path.isdir(q)])
+            + span_args
+        ):
+            # abspath-dedup: a dump named BOTH explicitly and via its
+            # containing run-dir arg must feed the fusion once, not
+            # twice (duplicate X events per Perfetto lane).
+            q_abs = os.path.abspath(q)
+            if q_abs in seen_abs:
+                continue
+            seen_abs.add(q_abs)
+            trace_paths.append(q)
+        spans, tskipped = load_spans(trace_paths)
+        if not spans:
+            raise SystemExit(
+                "flight merge: --trace-out found no spans (no "
+                "trace*.jsonl / trace*.json among the given dirs/files — "
+                "was the run traced? --trace-sample 0 records nothing)"
+            )
+        doc = chrome_trace(spans)
+        doc[_FUSED_KEY] = "python -m r2d2dpg_tpu.obs.flight merge"
+        _atomic_write(args.trace_out, json.dumps(doc, default=str))
+        tnote = f" ({tskipped} unparseable skipped)" if tskipped else ""
+        sys.stderr.write(
+            f"flight merge: {len(spans)} spans from {len(trace_paths)} "
+            f"trace dumps{tnote} -> {args.trace_out}\n"
+        )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,422 @@
+"""Topology-wide health verdicts (ISSUE 13 leg 3): is this run healthy NOW?
+
+The obs plane answers "what is the value of X" (registry/exporter) and
+"what happened" (flight recorder); nothing answers the operational
+question an autoscaler — or an operator mid-incident — actually asks:
+*is this composed topology healthy right now, and if not, which part*.
+This module is that decision layer: a small rule engine over the signals
+already on the single fleet scrape (the Ape-X operator-visibility line,
+PAPERS.md 1803.00933 — drive decisions from the ONE /metrics page), with
+a machine-readable verdict::
+
+    GET /health  ->  {"verdict": "ok" | "degraded" | "critical",
+                      "findings": [{"rule", "severity", "detail",
+                                    "value", "threshold"}, ...],
+                      "t_wall": ...}
+
+Rules (each one maps a documented failure mode to the gauge that is its
+evidence — docs/FLEET.md / docs/REPLAY.md failure matrices):
+
+- ``learner_starving``   learner/sampler wait p99 over threshold: the
+  fleet is not feeding the learner (add actors, or a shard is wedged).
+- ``telem_stale``        an actor's or standalone shard's TELEM staleness
+  gauge over threshold: that process is wedged, partitioned, or dead —
+  its mirrored series are holding last values, not reporting.
+- ``shard_skew``         one replay shard empty while the tier holds
+  real occupancy: routing/quota skew (a rejoined-empty shard absorbing
+  is expected and brief; a PERSISTENT zero is a feed problem).
+- ``eviction_churn``     ring evictions/s over threshold: experience is
+  being recycled before it is sampled — replay is undersized for the
+  collection rate (shed actors, or grow capacity).
+- ``actors_down``        live supervised actors below the spawn target.
+- ``shards_down``        live shard processes below the spawn target
+  (``critical`` when zero: sampling is fully degraded).
+
+The verdict is the max severity across findings; every verdict
+TRANSITION lands in the flight ring (``health_verdict`` events), so a
+post-mortem shows when the run degraded and when it recovered, and
+``r2d2dpg_health_*`` gauges put the verdict itself on the scrape.  This
+is precisely the input contract the ROADMAP autoscaler consumes — an
+autoscale decision is a planned reaction to a ``/health`` finding —
+built as observability first.
+
+Evaluation is pull-time (each ``GET /health`` — or an explicit
+``evaluate()``) over ``Registry.snapshot()`` merged with the
+``RemoteMirror``: no background thread, no extra device syncs, and a
+broken instrument degrades to "signal absent" (rules skip what they
+cannot read) rather than taking the endpoint down.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from typing import Dict, List, Optional
+
+from r2d2dpg_tpu.obs.flight import flight_event
+from r2d2dpg_tpu.obs.registry import (
+    Registry,
+    RemoteMirror,
+    get_registry,
+    merge_remote,
+)
+
+VERDICT_OK = "ok"
+VERDICT_DEGRADED = "degraded"
+VERDICT_CRITICAL = "critical"
+_SEVERITY = {VERDICT_OK: 0, VERDICT_DEGRADED: 1, VERDICT_CRITICAL: 2}
+
+# The fixed rule namespace: every rule's firing state is exported as
+# r2d2dpg_health_rule_firing{rule=...} including the ZEROS, so a cleared
+# finding reads as an explicit 0, never as a vanished series.
+RULES = (
+    "learner_starving",
+    "telem_stale",
+    "shard_skew",
+    "eviction_churn",
+    "actors_down",
+    "shards_down",
+    # The synthetic finding a raising rule degrades into (never a 500):
+    # exported like the real rules so a degraded verdict is always
+    # attributable to SOME firing series on the scrape.
+    "engine_error",
+)
+
+
+@dataclasses.dataclass
+class HealthConfig:
+    """Verdict thresholds.  Defaults are deliberately loose (a health
+    endpoint that cries wolf on warm-up noise trains operators to ignore
+    it); train.py exposes the two an operator actually tunes
+    (``--health-wait-p99``, ``--health-stale-after``)."""
+
+    learner_wait_p99_s: float = 0.5
+    telem_stale_after_s: float = 10.0
+    eviction_churn_per_s: float = 50.0
+    # Eviction rate windows shorter than this re-judge the previous full
+    # window: FIFO evictions land in whole-batch bursts, and a burst
+    # divided by a sub-second poll gap is not a sustained rate.
+    eviction_rate_min_dt_s: float = 5.0
+    # Skew is only judged once the tier holds real data: a shard at 0
+    # while the MEAN occupancy is below this floor is warm-up, not skew
+    # (the rejoined-empty-shard absorb phase must not read as degraded —
+    # the same fix class as the actor warm-up TELEM cadence).
+    occupancy_skew_min_mean: float = 64.0
+    expected_actors: int = 0  # 0 = rule disarmed
+    expected_shard_procs: int = 0  # 0 = rule disarmed
+    # Staleness gauges arm at HELLO whether or not the peers were told to
+    # push TELEM (actor/shard --telem-every rides --obs-fleet): on a run
+    # without it every clock grows forever, and firing telem_stale there
+    # would stamp every healthy non-obs-fleet run degraded.  train.py
+    # sets this from the resolved --obs-fleet; the default keeps the
+    # standalone-engine behavior (a gauge that exists is judged).
+    telem_expected: bool = True
+
+
+def _samples(snap: Dict, name: str) -> List[Dict]:
+    entry = snap.get(name)
+    if not isinstance(entry, dict):
+        return []
+    samples = entry.get("samples", ())
+    return [s for s in samples if isinstance(s, dict)]
+
+
+def _per_shard_max(snap: Dict, name: str) -> Dict[object, float]:
+    """One value per shard from a possibly-duplicated family: a shard's
+    series can appear TWICE in a merged snapshot — the learner's advert
+    mirror and the shard proc's TELEM copy share the metric name
+    (deployment, not semantics) — so samples dedupe on their ``shard``
+    label with max() (for monotone counters the larger IS the fresher
+    copy; for occupancy it errs toward "holds data").  Samples without a
+    shard label keep their own slots."""
+    per_shard: Dict[object, float] = {}
+    for i, s in enumerate(_samples(snap, name)):
+        v = _finite(s.get("value"))
+        if v is None:
+            continue
+        labels = s.get("labels")
+        key = (
+            labels.get("shard")
+            if isinstance(labels, dict) and "shard" in labels
+            else ("unlabelled", i)
+        )
+        per_shard[key] = max(per_shard.get(key, 0.0), v)
+    return per_shard
+
+
+def _finite(v) -> Optional[float]:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if math.isfinite(f) else None
+
+
+class HealthEngine:
+    """The rule engine behind ``GET /health``.
+
+    ``evaluate()`` is cheap (one registry snapshot + mirror merge) and
+    thread-safe; the exporter calls it per request.  State across calls:
+    the last verdict (for transition flight events) and the last
+    eviction total/timestamp (the churn rule needs a rate, and counters
+    only carry totals)."""
+
+    def __init__(
+        self,
+        config: Optional[HealthConfig] = None,
+        *,
+        registry: Optional[Registry] = None,
+        mirror: Optional[RemoteMirror] = None,
+    ):
+        self.config = config or HealthConfig()
+        self.registry = registry if registry is not None else get_registry()
+        self.mirror = mirror
+        self._lock = threading.Lock()
+        self._last_verdict: Optional[str] = None
+        self._evict_last: Optional[tuple] = None  # (t_mono, total)
+        self._evict_rate: Optional[float] = None  # last full-window rate
+        self._rules = (
+            self._rule_learner_starving,
+            self._rule_telem_stale,
+            self._rule_shard_skew,
+            self._rule_eviction_churn,
+            self._rule_procs_down,
+        )
+        reg = self.registry
+        self._obs_status = reg.gauge(
+            "r2d2dpg_health_status",
+            "the /health verdict as a level: 0 ok, 1 degraded, 2 critical "
+            "(refreshed at each /health evaluation)",
+        )
+        self._obs_findings = reg.gauge(
+            "r2d2dpg_health_findings",
+            "live /health findings at the last evaluation",
+        )
+        self._obs_rule = reg.gauge(
+            "r2d2dpg_health_rule_firing",
+            "1 while this health rule has a live finding, else 0",
+            labelnames=("rule",),
+        )
+        self._obs_transitions = reg.counter(
+            "r2d2dpg_health_transitions_total",
+            "verdict transitions (each one also lands in flight.jsonl as "
+            "a health_verdict event)",
+        )
+
+    # ----------------------------------------------------------------- rules
+    def _rule_learner_starving(self, snap, findings) -> None:
+        for name in (
+            "r2d2dpg_fleet_learner_wait_seconds",
+            "r2d2dpg_sampler_wait_seconds",
+        ):
+            for s in _samples(snap, name):
+                if not s.get("count"):
+                    continue
+                p99 = _finite(s.get("p99"))
+                if p99 is not None and p99 > self.config.learner_wait_p99_s:
+                    findings.append(
+                        {
+                            "rule": "learner_starving",
+                            "severity": VERDICT_DEGRADED,
+                            "detail": f"{name} p99 over threshold — the "
+                            "learner is waiting on experience",
+                            "value": p99,
+                            "threshold": self.config.learner_wait_p99_s,
+                        }
+                    )
+
+    def _rule_telem_stale(self, snap, findings) -> None:
+        if not self.config.telem_expected:
+            return  # no TELEM cadence armed: a growing clock is not a wedge
+        for name, unit in (
+            ("r2d2dpg_fleet_telem_staleness_seconds", "actor"),
+            ("r2d2dpg_shard_telem_staleness_seconds", "shard"),
+        ):
+            for s in _samples(snap, name):
+                v = _finite(s.get("value"))
+                if v is not None and v > self.config.telem_stale_after_s:
+                    who = s.get("labels", {}).get(unit, "?")
+                    findings.append(
+                        {
+                            "rule": "telem_stale",
+                            "severity": VERDICT_DEGRADED,
+                            "detail": f"{unit} {who} TELEM stale — wedged, "
+                            "partitioned, or dead (its mirrored series "
+                            "hold last values)",
+                            "value": v,
+                            "threshold": self.config.telem_stale_after_s,
+                        }
+                    )
+
+    def _rule_shard_skew(self, snap, findings) -> None:
+        # Dedupe per shard label (see _per_shard_max): raw samples would
+        # defeat the len>=2 single-shard guard, and a lagging TELEM copy
+        # (0 from the forced HELLO push) beside a climbing advert would
+        # read as a spuriously empty shard.  max() errs toward "holds
+        # data": this rule exists to flag an empty shard, and either
+        # copy showing occupancy disproves that.
+        occ = list(
+            _per_shard_max(snap, "r2d2dpg_replay_shard_occupancy").values()
+        )
+        if len(occ) < 2:
+            return
+        mean = sum(occ) / len(occ)
+        if mean >= self.config.occupancy_skew_min_mean and min(occ) == 0.0:
+            findings.append(
+                {
+                    "rule": "shard_skew",
+                    "severity": VERDICT_DEGRADED,
+                    "detail": "a replay shard sits empty while the tier "
+                    "holds data — routing/quota skew or a shard not "
+                    "being fed",
+                    "value": min(occ),
+                    "threshold": mean,
+                }
+            )
+
+    def _rule_eviction_churn(self, snap, findings) -> None:
+        # Both copies track one monotone quantity, so _per_shard_max's
+        # dedupe picks the fresher (larger); summing raw samples would
+        # double the rate and fire the rule at half the threshold.
+        per_shard = _per_shard_max(
+            snap, "r2d2dpg_replay_shard_evictions_total"
+        )
+        if not per_shard:
+            return
+        total = sum(per_shard.values())
+        now = time.monotonic()
+        with self._lock:
+            last = self._evict_last
+            if (
+                last is not None
+                and now - last[0] < self.config.eviction_rate_min_dt_s
+            ):
+                # Closely spaced polls (autoscaler racing an operator
+                # curl) re-judge the LAST full window instead of a
+                # fresh sub-second one: a single FIFO batch eviction —
+                # e.g. 64 slots in one instant — over a 0.5s gap reads
+                # as 128/s and flaps the verdict on a non-event.
+                rate = self._evict_rate
+            else:
+                if last is not None and now > last[0]:
+                    self._evict_rate = max(total - last[1], 0.0) / (
+                        now - last[0]
+                    )
+                self._evict_last = (now, total)
+                rate = self._evict_rate if last is not None else None
+        if rate is None:
+            return  # first sighting: no window yet
+        if rate > self.config.eviction_churn_per_s:
+            findings.append(
+                {
+                    "rule": "eviction_churn",
+                    "severity": VERDICT_DEGRADED,
+                    "detail": "replay rings are recycling experience "
+                    "faster than the threshold — replay undersized for "
+                    "the collection rate",
+                    "value": rate,
+                    "threshold": self.config.eviction_churn_per_s,
+                }
+            )
+
+    def _rule_procs_down(self, snap, findings) -> None:
+        for name, rule, expected in (
+            (
+                "r2d2dpg_fleet_actors_alive",
+                "actors_down",
+                self._expected_actors(snap),
+            ),
+            (
+                "r2d2dpg_shard_alive",
+                "shards_down",
+                self.config.expected_shard_procs,
+            ),
+        ):
+            if expected <= 0:
+                continue
+            samples = _samples(snap, name)
+            if not samples:
+                continue  # no supervisor in this process: rule disarmed
+            alive = _finite(samples[0].get("value"))
+            if alive is None or alive >= expected:
+                continue
+            findings.append(
+                {
+                    "rule": rule,
+                    "severity": (
+                        VERDICT_CRITICAL if alive == 0 else VERDICT_DEGRADED
+                    ),
+                    "detail": f"{name}: live supervised processes below "
+                    "the spawn target",
+                    "value": alive,
+                    "threshold": float(expected),
+                }
+            )
+
+    def _expected_actors(self, snap) -> int:
+        # The scrape itself carries the target when the ingest server
+        # registered it (r2d2dpg_fleet_actors_expected); the config value
+        # is the fallback for processes without an ingest server.
+        for s in _samples(snap, "r2d2dpg_fleet_actors_expected"):
+            v = _finite(s.get("value"))
+            if v is not None and v > 0:
+                return int(v)
+        return self.config.expected_actors
+
+    # -------------------------------------------------------------- evaluate
+    def evaluate(self) -> Dict:
+        """One verdict over the current registry (+ mirror) state.  Never
+        raises: a rule that cannot read its signal contributes nothing
+        (absence of evidence is not degradation — staleness gauges exist
+        so absence itself becomes a visible signal)."""
+        snap = self.registry.snapshot()
+        if self.mirror is not None:
+            sources = self.mirror.sources()
+            if sources:
+                snap = merge_remote(snap, sources)
+        findings: List[Dict] = []
+        for rule in self._rules:
+            try:
+                rule(snap, findings)
+            except Exception as e:  # noqa: BLE001 - verdict isolation
+                findings.append(
+                    {
+                        "rule": "engine_error",
+                        "severity": VERDICT_DEGRADED,
+                        "detail": f"health rule failed: "
+                        f"{type(e).__name__}: {e}",
+                        "value": None,
+                        "threshold": None,
+                    }
+                )
+        verdict = VERDICT_OK
+        for f in findings:
+            if _SEVERITY[f["severity"]] > _SEVERITY[verdict]:
+                verdict = f["severity"]
+        firing = {f["rule"] for f in findings}
+        self._obs_status.set(_SEVERITY[verdict])
+        self._obs_findings.set(len(findings))
+        for rule in RULES:
+            self._obs_rule.labels(rule=rule).set(1.0 if rule in firing else 0.0)
+        with self._lock:
+            previous = self._last_verdict
+            transition = verdict != previous
+            self._last_verdict = verdict
+        if transition:
+            # Every transition is post-mortem evidence: flight.jsonl says
+            # WHEN the run degraded and when it recovered, with the rules
+            # that drove the change.
+            self._obs_transitions.inc()
+            flight_event(
+                "health_verdict",
+                verdict=verdict,
+                previous=previous,
+                rules=sorted(firing),
+            )
+        return {
+            "verdict": verdict,
+            "findings": findings,
+            "t_wall": time.time(),
+        }

@@ -89,7 +89,24 @@ SAMPLER_HOPS = (
     "sample_req",
     "batch_return",
 )
-HOPS = WIRE_HOPS + SAMPLER_HOPS
+# Standalone-shard-tier hops (fleet/shard.py, ISSUE 13): with
+# ``--shard-procs N`` the sampler's SAMPLE_REQ carries the 32B trace
+# sidecar ACROSS the shard socket, and the shard process stamps its own
+# contiguous chain inside the learner's ``sample_req`` window —
+# ``req_receive`` (the learner's REQ pack stamp to the shard's post-
+# decode clock read: wire + decode), ``shard_draw`` (the prioritized
+# ring draw), ``batch_encode`` (BATCH pack + send, INCLUDING any chaos
+# stall gate — a wedged shard shows up as a fat batch_encode span, which
+# is exactly what the stall drill should look like on a timeline).
+# Recorded all-or-nothing after the BATCH send completes, in the shard
+# proc's own span ring, dumped as ``trace_shard<i>.jsonl`` and merged
+# into one Perfetto timeline by ``obs.flight merge --trace-out``.
+SHARD_HOPS = (
+    "req_receive",
+    "shard_draw",
+    "batch_encode",
+)
+HOPS = WIRE_HOPS + SAMPLER_HOPS + SHARD_HOPS
 
 
 @dataclasses.dataclass

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import json
 import os
 import time
 from typing import Optional
@@ -262,8 +263,24 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="fleet-wide metric aggregation: with --actors N, actors push "
         "~1 Hz TELEM registry snapshots that fold into this process's "
         "/metrics under actor=/host= labels (one scrape point per fleet, "
-        "with per-actor staleness gauges); on a multi-process SPMD run, "
-        "registry scalars process_allgather into process 0's exporter"
+        "with per-actor staleness gauges); with --shard-procs N the "
+        "standalone shard processes push the same TELEM over their "
+        "authenticated learner legs (shard=/host= labels, per-shard "
+        "staleness armed at HELLO and reset on epoch-bumped rejoin); on "
+        "a multi-process SPMD run, registry scalars process_allgather "
+        "into process 0's exporter"
+    )
+    # /health verdict thresholds (obs/health.py; the endpoint rides
+    # --obs-port's exporter — docs/OBSERVABILITY.md "/health verdicts").
+    p.add_argument(
+        "--health-wait-p99", type=float, default=0.5, metavar="S",
+        help="/health 'learner_starving' threshold: learner/sampler wait "
+        "p99 above this reads as the fleet failing to feed the learner"
+    )
+    p.add_argument(
+        "--health-stale-after", type=float, default=10.0, metavar="S",
+        help="/health 'telem_stale' threshold: an actor's or shard's "
+        "TELEM staleness above this reads as wedged/partitioned/dead"
     )
     p.add_argument(
         "--trace-sample", type=float, default=0.0, metavar="RATE",
@@ -328,6 +345,29 @@ def _apply_overrides(cfg: ExperimentConfig, args) -> ExperimentConfig:
     if args.compute_dtype is not None:
         cfg = dataclasses.replace(cfg, compute_dtype=args.compute_dtype)
     return cfg
+
+
+def _health_config(args) -> "obs.HealthConfig":
+    """The run's resolved /health thresholds + expected process counts.
+
+    One builder for BOTH consumers — the exporter's armed engine and the
+    fleet teardown's health_final.json fallback — so evidence stamped by
+    a run without a live exporter still judges against the real spawn
+    targets (a default HealthConfig has expected_actors=0 and
+    expected_shard_procs=0, which disarms actors_down/shards_down and
+    would stamp a dead shard tier as 'ok')."""
+    from r2d2dpg_tpu import obs
+
+    return obs.HealthConfig(
+        learner_wait_p99_s=args.health_wait_p99,
+        telem_stale_after_s=args.health_stale_after,
+        expected_actors=args.actors or 0,
+        expected_shard_procs=args.shard_procs or 0,
+        # Staleness clocks arm at HELLO regardless, but TELEM pushes only
+        # ride --obs-fleet — without it a growing clock is configuration,
+        # not a wedged peer.
+        telem_expected=bool(getattr(args, "obs_fleet", 0)),
+    )
 
 
 def run(args) -> dict:
@@ -471,8 +511,22 @@ def run(args) -> dict:
     exporter = None
     if args.obs_port is not None:
         exporter = obs.start_exporter(args.obs_port, registry, args.obs_host)
+        # The /health verdict engine (ISSUE 13 leg 3), armed with this
+        # run's RESOLVED topology so actors_down/shards_down compare
+        # against the real spawn targets — the autoscaler's input
+        # contract, live from the first scrape.  arm_health(): the server
+        # is already answering GETs, and the handler's lazy default must
+        # never outrace this configured engine.
+        exporter.arm_health(
+            obs.HealthEngine(
+                _health_config(args),
+                registry=registry,
+                mirror=obs.get_remote_mirror(),
+            )
+        )
         print(
-            f"obs: /metrics + /metrics.json on port {exporter.port}",
+            f"obs: /metrics + /metrics.json + /health on port "
+            f"{exporter.port}",
             flush=True,
         )
         if args.logdir:
@@ -826,6 +880,7 @@ def _run_fleet(
         WireConfig,
         default_actor_argv,
     )
+    from r2d2dpg_tpu import obs
     from r2d2dpg_tpu.fleet import chaos as fleet_chaos
     from r2d2dpg_tpu.fleet import transport as fleet_transport
     from r2d2dpg_tpu.fleet.ingest import load_fleet_counters
@@ -929,6 +984,10 @@ def _run_fleet(
             heartbeat_s=heartbeat_s,
             chaos_spec=args.chaos_spec,
             flight_dir=args.logdir,
+            # The shard tier joins the --obs-fleet plane at the actors'
+            # cadence: every shard proc's registry lands in THIS
+            # process's /metrics under shard=/host= labels (ISSUE 13).
+            telem_every=1.0 if args.obs_fleet else 0.0,
         )
     learner = topology.build_fleet_learner(
         topo, trainer, fleet_config, replay_capacity=replay_capacity,
@@ -1127,6 +1186,45 @@ def _run_fleet(
     except DivergenceError as e:
         _abort_on_divergence(e, flight, flight_path, ckpt)
     finally:
+        if args.logdir:
+            # The run's FINAL merged scrape + /health verdict as durable
+            # evidence (ISSUE 13): lib_gate.sh shard_gate refuses
+            # --shard-procs evidence whose scrape lacks a live shard's
+            # labelled series, and bench stamps the end-of-run verdict —
+            # both read these files, no live exporter needed post-run.
+            # Written BEFORE the supervisors stop: the verdict must
+            # describe the RUN's end state, not the teardown's (stopped
+            # supervisors read alive=0, which would stamp every clean
+            # exit as critical/shards_down).
+            try:
+                snap = obs.get_registry().snapshot()
+                sources = obs.get_remote_mirror().sources()
+                if sources:
+                    snap = obs.merge_remote(snap, sources)
+                with open(
+                    os.path.join(args.logdir, "metrics_final.prom"), "w"
+                ) as f:
+                    f.write(obs.render_prometheus(snap))
+                engine = getattr(obs.current_exporter(), "health", None)
+                if engine is None:
+                    # No armed exporter engine (e.g. no --obs-port):
+                    # judge with the run's resolved config anyway —
+                    # defaults would disarm actors_down/shards_down.
+                    engine = obs.HealthEngine(
+                        _health_config(args),
+                        registry=obs.get_registry(),
+                        mirror=obs.get_remote_mirror(),
+                    )
+                with open(
+                    os.path.join(args.logdir, "health_final.json"), "w"
+                ) as f:
+                    json.dump(engine.evaluate(), f, default=str)
+            except Exception as e:  # noqa: BLE001 — evidence is optional,
+                # the teardown below it is NOT: an exception escaping this
+                # finally block would skip supervisor/shard-tier/learner
+                # teardown (orphaning their process groups) and mask the
+                # run's own error.  Loud note, never a raise.
+                print(f"obs: final evidence stamp failed: {e!r}", flush=True)
         # Supervisor FIRST (its stopping flag makes the actors' connection
         # loss an orderly exit, not a crash to restart), then the SHARD
         # TIER (its stop flag releases any ingest handler parked in the

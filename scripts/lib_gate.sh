@@ -375,6 +375,58 @@ sampler_gate() {
   return 1
 }
 
+# Scrape-evidence check for --shard-procs dirs (ISSUE 13): every live
+# shard 0..N-1 must have its labelled occupancy series in the run's
+# final merged scrape, and every shard HOLDING data must have folded at
+# least one TELEM snapshot (r2d2dpg_shard_telem_frames_total > 0).  The
+# advert-mirror occupancy series alone is the learner talking to itself
+# (RemoteShardSet registers it for every shard unconditionally), so it
+# cannot distinguish an observability-dark shard proc from a healthy
+# one — the TELEM counter only gets a labelled cell when a shard-proc
+# snapshot actually crossed the wire and folded.  Idle shards (advert
+# occupancy 0; the learner dials lazily, so an untrafficked shard never
+# HELLOs and never pushes) are exempt — shard_skew is their signal.
+# NB this means --shard-procs evidence must run the health plane
+# (--obs-fleet 1 arms the shard-proc TELEM cadence).  Cheap (grep per
+# shard), so it re-runs on every gate pass instead of hiding behind the
+# anchor stamp.
+#   shard_scrape_check <dir> <num_shards>
+shard_scrape_check() {
+  local dir=$1 n=$2 i occ prom
+  prom=$dir/metrics_final.prom
+  if [ ! -f "$prom" ]; then
+    echo "$dir: shard_gate: metrics_final.prom missing — the run left no" \
+         "final scrape to attribute the shard tier's numbers to"
+    return 1
+  fi
+  for i in $(seq 0 $((n - 1))); do
+    if ! grep -Eq "r2d2dpg_replay_shard_occupancy\{[^}]*shard=\"$i\"" \
+         "$prom"; then
+      echo "$dir: shard_gate: scrape lacks shard $i's labelled occupancy" \
+         "series (metrics_final.prom) — an observability-dark shard" \
+         "cannot be blessed as evidence"
+      return 1
+    fi
+    # The advert-mirror series renders with shard= as its only label;
+    # the TELEM-folded copy carries host= attribution.
+    occ=$(grep -E "^r2d2dpg_replay_shard_occupancy\{shard=\"$i\"\} " \
+            "$prom" | head -1 | awk '{print $2}')
+    if [ -n "$occ" ] && awk -v o="$occ" 'BEGIN{exit !(o > 0)}'; then
+      if ! grep -E \
+           "^r2d2dpg_shard_telem_frames_total\{[^}]*shard=\"$i\"[^}]*\} " \
+           "$prom" | awk '{s+=$2} END{exit !(s > 0)}'; then
+        echo "$dir: shard_gate: shard $i holds data (advert occupancy" \
+          "$occ) but folded no TELEM snapshot (metrics_final.prom has no" \
+          "r2d2dpg_shard_telem_frames_total{shard=\"$i\"} > 0) — an" \
+          "observability-dark shard proc cannot be blessed as evidence" \
+          "(run with --obs-fleet 1)"
+        return 1
+      fi
+    fi
+  done
+  return 0
+}
+
 # Standalone-shard-tier gate (ISSUE 12): a run dir trained with
 # --shard-procs N may only be blessed (.done) if the shard-tier anchors
 # pass on this checkout — the loopback-vs-out-of-process determinism
@@ -388,19 +440,28 @@ sampler_gate() {
 # replay_shards.txt, so a blessed number always says where replay
 # LIVED.  Same stamping discipline as fleet_gate; loopback runs pass
 # through untouched.
+#
+# ISSUE 13 adds the scrape-evidence clause: the run's final merged
+# scrape (metrics_final.prom, written by train.py's fleet teardown)
+# must carry EVERY shard's labelled occupancy series — a shard that is
+# observability-dark (its TELEM never folded, its advert mirror never
+# registered) must not be blessed as evidence, because the numbers it
+# contributed cannot be attributed on the one fleet /metrics page.
 #   shard_gate <dir> <train args...>
 shard_gate() {
   local dir=$1
   shift
-  local _sp="" _sp_prev=""
+  local _sp="" _rs="" _sp_prev=""
   local _sp_arg
   for _sp_arg in "$@"; do
     # Both argparse spellings: "--flag value" and "--flag=value".
     case "$_sp_arg" in
       --shard-procs=*) _sp=${_sp_arg#*=} ;;
+      --replay-shards=*) _rs=${_sp_arg#*=} ;;
     esac
     case "$_sp_prev" in
       --shard-procs) _sp=$_sp_arg ;;
+      --replay-shards) _rs=$_sp_arg ;;
     esac
     _sp_prev=$_sp_arg
   done
@@ -408,6 +469,9 @@ shard_gate() {
     return 0  # in-learner loopback (or no sampler path): nothing to gate
   fi
   printf 'shard_procs=%s\n' "$_sp" > "$dir/shard_procs.txt"
+  if ! shard_scrape_check "$dir" "${_rs:-$_sp}"; then
+    return 1
+  fi
   if [ -f "$dir/.shard_tier_ok" ]; then
     return 0
   fi

@@ -37,9 +37,12 @@ fi
 # r2d2dpg_<subsystem>_<metric> scheme (docs/OBSERVABILITY.md) or appear in
 # scripts/obs_metric_allowlist.txt.  A scan of literal first arguments to
 # .counter(/.gauge(/.histogram( — registrations span lines, so the scan is
-# a small python (re over whole files), not a line grep.  f-string names
-# (e.g. the per-hop trace histograms) parameterize an already-conforming
-# prefix and are out of scope for a literal scan.
+# a small python (re over whole files), not a line grep; the rglob covers
+# every library module incl. the shard-proc side (fleet/shard.py, whose
+# registrations feed the TELEM plane — ISSUE 13).  The one f-string
+# family (the per-hop trace histograms) is expanded EXPLICITLY from the
+# hop namespace below, so a new hop (e.g. the shard-tier req_receive/
+# shard_draw/batch_encode) cannot mint a non-conforming name unseen.
 python - <<'EOF'
 import re
 import sys
@@ -60,6 +63,17 @@ for path in sorted(Path("r2d2dpg_tpu").rglob("*.py")):
     for name in pat.findall(path.read_text()):
         if not scheme.match(name) and name not in allow:
             bad.append(f"{path}: {name}")
+# The parameterized trace-hop histograms (obs/trace.py hop_histogram):
+# expand the hop namespace and hold each concrete name to the scheme.
+# (Guarded on the module existing so partial checkouts — the lint's own
+# offender-fixture tree — still scan their literals.)
+if Path("r2d2dpg_tpu/obs/trace.py").exists():
+    from r2d2dpg_tpu.obs.trace import HOPS  # noqa: E402 (after the scan)
+
+    for hop in HOPS:
+        name = f"r2d2dpg_trace_{hop}_seconds"
+        if not scheme.match(name) and name not in allow:
+            bad.append(f"r2d2dpg_tpu/obs/trace.py (hop {hop!r}): {name}")
 if bad:
     print("\n".join(bad))
     print(

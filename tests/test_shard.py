@@ -18,12 +18,17 @@ Anchors ``scripts/lib_gate.sh shard_gate`` enforces before blessing
   zero false reaps through the stall.
 """
 
+import glob
+import json
+import re
+import socket
 import threading
 import time
 
 import numpy as np
 import pytest
 
+from r2d2dpg_tpu import obs
 from r2d2dpg_tpu.configs import PENDULUM_TINY
 from r2d2dpg_tpu.fleet import chaos as fleet_chaos
 from r2d2dpg_tpu.fleet import transport, wire
@@ -36,6 +41,8 @@ from r2d2dpg_tpu.fleet.shard import (
 )
 from r2d2dpg_tpu.fleet.supervisor import SupervisorConfig
 from r2d2dpg_tpu.obs import get_flight_recorder
+from r2d2dpg_tpu.obs import registry as obs_registry
+from r2d2dpg_tpu.obs.trace import SHARD_HOPS
 from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
 from r2d2dpg_tpu.replay.sharded import ReplayShard
 
@@ -278,16 +285,242 @@ def test_shard_chaos_stall_gate_arms_and_waits():
     assert time.monotonic() - t0 < 0.05  # not its fault
 
 
+# ----------------------------------------------------- shard TELEM (ISSUE 13)
+@pytest.fixture
+def fresh_obs(monkeypatch):
+    """A fresh process registry + remote mirror for the duration of one
+    test: the TELEM fold and the /health rules read process singletons,
+    and an earlier test's armed staleness set_fn (its server long closed)
+    would otherwise fire the telem_stale rule forever."""
+    monkeypatch.setattr(obs_registry, "_REGISTRY", obs_registry.Registry())
+    monkeypatch.setattr(obs_registry, "_MIRROR", obs_registry.RemoteMirror())
+    return obs_registry.get_registry(), obs_registry.get_remote_mirror()
+
+
+def test_shard_telem_folds_with_staleness_and_epoch_rearm(fresh_obs):
+    """Leg 1 of the health plane: a shard proc's TELEM push lands in the
+    learner's RemoteMirror under shard=/host= labels (idempotently keyed
+    — a respawned incarnation UPDATES its slot), the per-shard staleness
+    gauge grows while the shard is silent, and an epoch-bumped rejoin
+    RESTARTS the clock so a fresh incarnation's absorb phase never reads
+    as wedged (the actor warm-up cadence fix, carried to the shard
+    tier)."""
+    reg, mirror = fresh_obs
+    srv = ShardServer(
+        ReplayShard(8, alpha=1.0, shard_id=0),
+        epoch=1, seed=0, telem_every=0.01,
+    ).start()
+    addrs = {0: srv.address}
+    ss = RemoteShardSet(
+        1, lambda sid: addrs[sid],
+        wire_config=wire.WireConfig(), rejoin_interval_s=0.0,
+    )
+    try:
+        # First exchange: HELLO arms the staleness clock, and the forced
+        # post-HELLO TELEM push folds on this exchange's reply read.
+        ss.add(0, {"staged": _np_staged()})
+        sources = mirror.sources()
+        assert len(sources) == 1
+        key, labels, snap = sources[0]
+        assert key == "shard:0"
+        assert labels["shard"] == "0" and labels["host"]
+        # The pushes ride AFTER replies, so a snapshot folds on the NEXT
+        # exchange's read — and the cadence gate makes WHICH rider
+        # carries a given snapshot scheduling-dependent: poll adds until
+        # a fold with real occupancy lands instead of assuming the
+        # schedule (a descheduled handler shifts it by one exchange).
+        occ = []
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            time.sleep(0.03)  # past the 0.01 s cadence: the rider is due
+            ss.add(0, {"staged": _np_staged()})
+            snap = mirror.sources()[0][2]
+            occ = snap.get("r2d2dpg_replay_shard_occupancy", {}).get(
+                "samples", []
+            )
+            if occ and occ[0]["value"] >= 3.0:
+                break
+        assert occ and occ[0]["value"] >= 3.0
+        assert occ[0]["labels"]["shard"] == "0"
+        # The fold's own accounting must NOT ride the push (echo
+        # suppression): the learner's staleness gauge stays live-only.
+        assert "r2d2dpg_shard_telem_staleness_seconds" not in snap
+        # Same echo class, whole learner-owned FAMILIES: with a shared
+        # registry (this very test) the proc-wide slice would push a
+        # frozen copy of e.g. the learner's wait histogram back under
+        # shard= attribution — and /health's learner_starving would keep
+        # judging the dead mirrored sample after the live one recovered.
+        reg.histogram("r2d2dpg_sampler_wait_seconds").observe(99.0)
+        reg.gauge("r2d2dpg_health_status").set(1.0)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            time.sleep(0.03)
+            ss.add(0, {"staged": _np_staged()})
+            snap = mirror.sources()[0][2]
+            if "r2d2dpg_replay_shard_occupancy" in snap:
+                break
+        assert "r2d2dpg_sampler_wait_seconds" not in snap
+        assert "r2d2dpg_health_status" not in snap
+        stale = reg.get("r2d2dpg_shard_telem_staleness_seconds").labels(
+            shard="0"
+        )
+        # Silence makes the gauge GROW — a wedged shard is visibly
+        # stale, never a silently flat mirrored series.
+        s0 = stale.value
+        time.sleep(0.3)
+        assert stale.value >= s0 + 0.25
+        # --- respawn under a bumped epoch: new server, same shard id.
+        srv2 = ShardServer(
+            ReplayShard(8, alpha=1.0, shard_id=0),
+            epoch=2, seed=0, telem_every=0.01,
+        ).start()
+        addrs[0] = srv2.address
+        srv.stop()
+        ss.add(0, {"staged": _np_staged()})  # torn conn -> re-dial -> HELLO
+        assert ss.shards[0].epoch == 2
+        # The incarnation's HELLO re-armed the clock: staleness restarted
+        # well below the dead incarnation's accumulated silence.
+        assert stale.value < 0.25
+        assert len(mirror.sources()) == 1  # same key, updated in place
+        srv2.stop()
+    finally:
+        ss.close()
+        srv.stop()
+
+
+def test_shard_telem_malformed_dropped_without_connection_loss(fresh_obs):
+    """A malformed TELEM frame on a shard leg costs one flight event,
+    never the connection: the tolerant reply read keeps the exchange
+    alive, and a payload that contradicts its connection's shard id is
+    malformed by definition (identity comes from the socket, so a
+    confused frame cannot relabel another shard's series)."""
+    reg, mirror = fresh_obs
+    ss = RemoteShardSet(
+        1, lambda sid: None, wire_config=wire.WireConfig()
+    )
+    rs = ss.shards[0]
+    a, b = socket.socketpair()
+    n0 = len(get_flight_recorder().events())
+    try:
+        a.settimeout(10)
+        # Three TELEM pushes ahead of the real reply: garbage, a wrong
+        # shard claim, then a WELL-FORMED one; the ACK follows.
+        transport.send_frame(
+            b, transport.K_TELEM, transport.pack_obj(["not", "a", "dict"])
+        )
+        transport.send_frame(
+            b,
+            transport.K_TELEM,
+            transport.pack_obj({"shard": 5, "snapshot": {}}),
+        )
+        transport.send_frame(
+            b,
+            transport.K_TELEM,
+            transport.pack_obj(
+                {"shard": 0, "epoch": 1, "host": "h", "snapshot": {}}
+            ),
+        )
+        transport.send_frame(
+            b, transport.K_ACK, transport.pack_obj({"code": "ok"})
+        )
+        kind, _payload = rs._recv("ingest", a)
+        assert kind == transport.K_ACK  # the reply survived all three
+        drops = [
+            e
+            for e in get_flight_recorder().events()[n0:]
+            if e["kind"] == "shard_telem_malformed"
+        ]
+        assert len(drops) == 2  # one per malformed frame, none for the good
+        assert [s[0] for s in mirror.sources()] == ["shard:0"]
+    finally:
+        a.close()
+        b.close()
+        ss.close()
+
+
+def test_stall_shard_staleness_health_degraded_then_ok(fresh_obs):
+    """The stall drill as the /health fixture: mid-``stall_shard`` the
+    shard answers nothing, so its TELEM staleness crosses the threshold
+    and ``GET /health`` reads ``degraded`` with a ``telem_stale`` finding
+    naming the shard; once the gate lifts and the next exchange folds the
+    buffered push, the verdict recovers to ``ok`` — and both transitions
+    are durable flight events."""
+    reg, mirror = fresh_obs
+    faults = fleet_chaos.parse_chaos_spec("stall_shard@p2:1.2s")
+    chaos = fleet_chaos.ShardChaos(
+        faults, seed=0, num_shard_procs=1, proc_index=0
+    )
+    srv = ShardServer(
+        ReplayShard(16, alpha=1.0, shard_id=0),
+        epoch=1, seed=0, chaos=chaos, telem_every=0.01,
+    ).start()
+    addrs = {0: srv.address}
+    ss = RemoteShardSet(
+        1, lambda sid: addrs[sid],
+        wire_config=wire.WireConfig(), rejoin_interval_s=0.0,
+    )
+    engine = obs.HealthEngine(
+        obs.HealthConfig(
+            telem_stale_after_s=0.3, learner_wait_p99_s=1e9,
+            eviction_churn_per_s=1e18,
+        ),
+        registry=reg,
+        mirror=mirror,
+    )
+    n0 = len(get_flight_recorder().events())
+    try:
+        ss.add(0, {"staged": _np_staged()})  # frame 1: TELEM armed + folded
+        assert engine.evaluate()["verdict"] == "ok"
+        # Frame 2 arms the stall: the gated ack parks this add for the
+        # stall's duration, during which the shard pushes nothing.
+        blocked = threading.Thread(
+            target=lambda: ss.add(0, {"staged": _np_staged()}), daemon=True
+        )
+        t_stall = time.monotonic()
+        blocked.start()
+        time.sleep(0.7)  # mid-stall, well past the 0.3 s threshold
+        res = engine.evaluate()
+        stale = [f for f in res["findings"] if f["rule"] == "telem_stale"]
+        assert res["verdict"] == "degraded"
+        assert stale and "shard 0" in stale[0]["detail"]
+        blocked.join(timeout=10)
+        assert time.monotonic() - t_stall >= 1.0  # the gate really held
+        # Recovery: the post-stall ack's TELEM rider folds on the next
+        # exchange, resetting the staleness clock.
+        ss.add(0, {"staged": _np_staged()})
+        res = engine.evaluate()
+        assert res["verdict"] == "ok"
+        verdicts = [
+            (e.get("previous"), e["verdict"])
+            for e in get_flight_recorder().events()[n0:]
+            if e["kind"] == "health_verdict"
+        ]
+        assert (None, "ok") in verdicts  # armed
+        assert ("ok", "degraded") in verdicts  # degraded during the stall
+        assert ("degraded", "ok") in verdicts  # recovered after it
+        assert reg.get("r2d2dpg_health_status").value == 0.0
+    finally:
+        ss.close()
+        srv.stop()
+
+
 # --------------------------------------------------------------- chaos e2e
 @pytest.mark.chaos
-def test_chaos_kill_shard_stall_and_partition_e2e(tmp_path):
+def test_chaos_kill_shard_stall_and_partition_e2e(tmp_path, fresh_obs):
     """The acceptance drill (non-slow, 2 actors x 2 REAL shard procs):
     ``stall_shard`` + ``partition_shard`` + ``kill_shard`` in one run —
     the run completes its full phase schedule, counters stay monotone,
     zero sheds and zero false reaps through the stall, the dead shard's
     quota renormalizes to the survivor, and after the supervisor's
     backoff restart the shard rejoins EMPTY under a bumped epoch, serves
-    traffic on both legs, and fences stale-epoch write-backs."""
+    traffic on both legs, and fences stale-epoch write-backs.
+
+    The ISSUE 13 health-plane half rides the same run: every shard's
+    ring series + staleness gauge in ONE merged scrape (shard-proc TELEM
+    at 0.05 s cadence), ``/health`` degraded with a ``shards_down``
+    finding during the kill window and ``ok`` after the rejoin, and the
+    trace plane (rate 1.0) yielding complete learner->shard->learner
+    chains fused into one timeline by ``obs.flight merge --trace-out``."""
     import queue as _q
 
     from r2d2dpg_tpu.fleet import FleetConfig, SamplerLearner
@@ -351,6 +584,7 @@ def test_chaos_kill_shard_stall_and_partition_e2e(tmp_path):
         wire_config=wire.WireConfig(),
         chaos_spec=spec,
         flight_dir=str(tmp_path),
+        telem_every=0.05,
         supervisor_config=SupervisorConfig(
             backoff_base_s=0.2, poll_s=0.05
         ),
@@ -365,6 +599,41 @@ def test_chaos_kill_shard_stall_and_partition_e2e(tmp_path):
         faults, seed=SEED, num_actors=2, server=learner.server,
         shard_tier=tier,
     )
+    # The /health verdict engine over the run's registry+mirror: every
+    # rule but shards_down disarmed (generous thresholds) so the ONE
+    # deterministic degraded window — the kill -> backoff-restart gap —
+    # is what the verdict sequence pins.
+    health = obs.HealthEngine(
+        obs.HealthConfig(
+            learner_wait_p99_s=1e9,
+            telem_stale_after_s=1e9,
+            eviction_churn_per_s=1e18,
+            occupancy_skew_min_mean=1e18,
+            expected_shard_procs=2,
+        ),
+        registry=fresh_obs[0],
+        mirror=fresh_obs[1],
+    )
+    health_findings = []
+
+    def phase_hook(p):
+        engine.on_phase(p)
+        if p == 2:
+            # kill_shard just landed on proc 0: the supervisor's backoff
+            # (0.2 s) guarantees a down window — catch the shards_down
+            # verdict inside it.
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                res = health.evaluate()
+                down = [
+                    f for f in res["findings"]
+                    if f["rule"] == "shards_down"
+                ]
+                if down:
+                    health_findings.append((res["verdict"], down[0]))
+                    break
+                time.sleep(0.01)
+
     tier.start()
     address = learner.start()
     stop = threading.Event()
@@ -418,6 +687,7 @@ def test_chaos_kill_shard_stall_and_partition_e2e(tmp_path):
     ]
     logged = []
     n0 = len(get_flight_recorder().events())
+    s0 = len(get_flight_recorder().spans())
     try:
         for t in threads:
             t.start()
@@ -426,7 +696,8 @@ def test_chaos_kill_shard_stall_and_partition_e2e(tmp_path):
             state=state,
             log_every=2,
             metrics_fn=lambda p, s: logged.append((p, dict(s))),
-            phase_fn=engine.on_phase,
+            phase_fn=phase_hook,
+            trace_sample=1.0,
         )
     finally:
         stop.set()
@@ -473,6 +744,29 @@ def test_chaos_kill_shard_stall_and_partition_e2e(tmp_path):
             resp["slots"], resp["gens"], np.ones(2, np.float32), epoch=1
         )
         assert stale["applied"] == 0 and stale["stale"]
+        # --- health plane (ISSUE 13): degraded with a shards_down
+        # finding during the kill window, ok after the rejoin.
+        assert health_findings, "no shards_down verdict in the kill window"
+        verdict, finding = health_findings[0]
+        assert verdict == "degraded" and finding["value"] == 1.0
+        assert health.evaluate()["verdict"] == "ok"
+        # --- ONE merged scrape carries every shard's ring series (from
+        # the shard procs' TELEM pushes) AND both staleness gauges.
+        reg, mirror = fresh_obs
+        assert {k for k, _, _ in mirror.sources()} >= {"shard:0", "shard:1"}
+        text = obs.render_prometheus(
+            obs.merge_remote(reg.snapshot(), mirror.sources())
+        )
+        for sid in ("0", "1"):
+            for metric in (
+                "r2d2dpg_replay_shard_occupancy",
+                "r2d2dpg_replay_shard_priority_sum",
+                "r2d2dpg_replay_shard_evictions_total",
+                "r2d2dpg_shard_telem_staleness_seconds",
+            ):
+                assert re.search(
+                    metric + r'\{[^}]*shard="' + sid + '"', text
+                ), f"{metric}{{shard={sid}}} missing from the merged scrape"
     finally:
         tier.stop()
     # The shard-side stall drill left durable evidence in its dump, and
@@ -485,3 +779,77 @@ def test_chaos_kill_shard_stall_and_partition_e2e(tmp_path):
     )
     restarts = tier.restarts_total
     assert restarts >= 1  # the supervisor's ladder did the rejoin
+    # --- cross-boundary tracing (ISSUE 13 leg 2): every phase was
+    # sampled (rate 1.0); the learner chain's contiguous hops sum to its
+    # end-to-end within 10%, and the shard procs stamped their own
+    # contiguous req_receive -> shard_draw -> batch_encode chains into
+    # the SAME trace ids, dumped as trace_shard<i>.jsonl at SIGTERM.
+    spans = get_flight_recorder().spans()[s0:]
+    by_id = {}
+    for s in spans:
+        by_id.setdefault(s["trace_id"], {})[s["hop"]] = s
+    chains = {
+        tid: h
+        for tid, h in by_id.items()
+        if {"sample_req", "batch_return", "learn"} <= set(h)
+    }
+    assert len(chains) == N_TRAIN
+    for h in chains.values():
+        end_to_end = (
+            h["learn"]["t_wall"] + h["learn"]["dur_s"]
+            - h["sample_req"]["t_wall"]
+        )
+        total = sum(
+            h[k]["dur_s"] for k in ("sample_req", "batch_return", "learn")
+        )
+        assert abs(total - end_to_end) <= 0.1 * end_to_end
+    shard_spans = []
+    for path in glob.glob(str(tmp_path / "trace_shard*.jsonl")):
+        with open(path) as f:
+            for line in f:
+                s = json.loads(line)
+                s["file"] = path.rsplit("/", 1)[-1]
+                shard_spans.append(s)
+    shard_chains = {}
+    for s in shard_spans:
+        shard_chains.setdefault(
+            (s["file"], s["trace_id"]), {}
+        )[s["hop"]] = s
+    complete = {
+        k: h
+        for k, h in shard_chains.items()
+        if set(SHARD_HOPS) <= set(h) and k[1] in chains
+    }
+    assert complete, "no complete shard-side chain matched a learner trace"
+    for (_, tid), h in complete.items():
+        # Contiguous by construction, nested inside the learner's
+        # sample_req window (both clocks are this host's wall clock).
+        assert (
+            h["req_receive"]["t_wall"] + h["req_receive"]["dur_s"]
+            == h["shard_draw"]["t_wall"]
+        )
+        assert (
+            h["shard_draw"]["t_wall"] + h["shard_draw"]["dur_s"]
+            == h["batch_encode"]["t_wall"]
+        )
+        shard_total = sum(h[k]["dur_s"] for k in SHARD_HOPS)
+        assert shard_total <= chains[tid]["sample_req"]["dur_s"] + 0.05
+    # --- one fused Perfetto timeline: learner spans (trace.json) +
+    # shard-proc span rings, merged by the run-dir CLI.
+    from r2d2dpg_tpu.obs.flight import main as flight_main
+
+    get_flight_recorder().dump_trace(str(tmp_path / "trace.json"))
+    flight_main(
+        ["merge", str(tmp_path), "--trace-out", str(tmp_path / "fused.json")]
+    )
+    with open(tmp_path / "fused.json") as f:
+        fused = json.load(f)
+    names = {e["name"] for e in fused["traceEvents"]}
+    assert {"sample_req", "batch_return", "learn"} <= names
+    assert set(SHARD_HOPS) <= names
+    stamped = {
+        e["args"].get("file")
+        for e in fused["traceEvents"]
+        if e["name"] in SHARD_HOPS
+    }
+    assert all(s and s.startswith("trace_shard") for s in stamped)
