@@ -633,6 +633,7 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
             "bytes_per_trained_seq": round(s["bytes_per_trained_seq"], 1),
             "wire_ratio": round(s["wire_ratio"], 3),
             "coalesce_width_mean": round(s["drain_coalesce_width_mean"], 2),
+            **_device_cols(s),
         }
 
     def sampler_leg(
@@ -690,6 +691,7 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
             "sample_bytes_total": round(s["sample_bytes_total"], 0),
             "replay_occupancy": s["replay_occupancy"],
             "sampler_wait_p99_ms": round(s["sampler_wait_p99_ms"], 1),
+            **_device_cols(s),
         }
 
     rec = {
@@ -809,12 +811,33 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
             "sampler_bytes_reduction_vs_central is the headline 'only "
             "sampled sequences cross' ratio, and its learner free-runs "
             "(pull-paced, not arrival-paced) so steps/s is not "
-            "comparable to the drain legs' arrival-paced rate"
+            "comparable to the drain legs' arrival-paced rate; every "
+            "fleet leg records the device-plane ledger (ISSUE 14: "
+            "compile_count / steady_recompiles / peak_hbm_bytes from "
+            "obs/device.py), and fleet_composed REFUSES to read as a "
+            "clean run unless steady_recompiles == 0 — the aval-"
+            "stability claim the PR 9/11 out_shardings pins make, now "
+            "measured instead of assumed"
         )
     except Exception as e:  # noqa: BLE001 — the JSON line is the contract
         rec["value"] = 0.0
         rec["error"] = f"{type(e).__name__}: {e}"[-400:]
     print(json.dumps(rec))
+
+
+def _device_cols(stats: dict) -> dict:
+    """The device-plane columns every fleet leg records (ISSUE 14): the
+    run's compile ledger and peak HBM, straight off the learner's stats
+    (in-process legs) or the parsed ``fleet:`` stats line (subprocess
+    legs).  ``steady_recompiles`` is the headline: a nonzero value means
+    a learn/drain program's avals re-keyed mid-run — the silent-stall
+    bug class the sentinel exists for — and the composed leg refuses to
+    record it as a clean run."""
+    return {
+        "compile_count": stats.get("compile_count", -1.0),
+        "steady_recompiles": stats.get("steady_recompiles", -1.0),
+        "peak_hbm_bytes": stats.get("peak_hbm_bytes", 0.0),
+    }
 
 
 def _parse_fleet_stats(stdout: str) -> dict:
@@ -885,6 +908,7 @@ def _learner_dp_leg(dp: int, phases: int) -> dict:
         "learner_wait_p99_ms": round(
             stats.get("learner_wait_p99_ms", 0.0), 1
         ),
+        **_device_cols(stats),
     }
     if rc != 0:
         # The stats line printed but the child died in teardown (final
@@ -962,7 +986,18 @@ def _composed_leg(phases: int = 12) -> dict:
         "sheds": stats.get("sheds", -1.0),
         "replay_occupancy": stats.get("replay_occupancy", 0.0),
         "overlap_fraction": round(stats.get("overlap_fraction", 0.0), 3),
+        **_device_cols(stats),
     }
+    if leg["steady_recompiles"] > 0.0:
+        # The composed run is exactly the topology whose donated-chain
+        # avals the PR 9/11 out_shardings pins keep stable: ANY steady
+        # recompile here is the re-key bug class live, and the record
+        # must refuse to read as a clean composition (ISSUE 14).
+        leg["error"] = (
+            f"steady_recompiles={leg['steady_recompiles']:g} — a "
+            "learn/drain program re-keyed mid-run (see steady_recompile "
+            "flight events); the composition did not run aval-stable"
+        )
     if rc != 0:
         leg["error"] = f"rc={rc}: {stderr[-300:]}"
     return leg
@@ -1116,6 +1151,7 @@ def _shard_procs_leg(phases: int = 12) -> dict:
             if t_kill is not None and t_dead is not None and t_dead >= t_kill
             else None
         ),
+        **_device_cols(stats),
     }
     # Scrape-path overhead (ISSUE 13): /metrics latency with 3 actors +
     # 2 shard procs all reporting into the one merged page.

@@ -74,6 +74,7 @@ from r2d2dpg_tpu.fleet.transport import (
 )
 from r2d2dpg_tpu.obs import flight_event, get_registry, get_remote_mirror
 from r2d2dpg_tpu.obs import trace as obs_trace
+from r2d2dpg_tpu.obs.device import flops_of, get_device_monitor
 from r2d2dpg_tpu.replay.arena import stack_staged, staged_nbytes
 from r2d2dpg_tpu.training.pipeline import (
     LearnerState,
@@ -1008,6 +1009,9 @@ class FleetLearner:
         # READY the pull limit is clamped to the widths that are
         # (_coalesce_ready), so the drain never blocks on a width compile.
         self._drain_exec: Dict[int, Any] = {}  # total staged B -> compiled
+        # Per-width cost_analysis FLOPs (the warm thread fills it): the
+        # MFU accounting bills each coalesced dispatch its exact width.
+        self._drain_flops: Dict[int, float] = {}
         self._coalesce_ready = 1
         self._warm_thread: Optional[threading.Thread] = None
         # Set when the run is over: the warm thread checks it between
@@ -1102,8 +1106,19 @@ class FleetLearner:
         staged-writer claim is skipped under trace — replay/arena.py).
         Any failure leaves the clamp at the widths already published
         (a ``drain_warm_failed`` flight event names it): narrower drains,
-        never a wrong or stalling one."""
+        never a wrong or stalling one.
+
+        Device-plane attribution (ISSUE 14 satellite): this thread's
+        compiles are DECLARED (an ``expected`` window — warm-window
+        compiles may legitimately land after the first drain-learn
+        marked steady in a future ordering) and labelled
+        ``fleet_drain_warm``, so the compile histograms attribute them
+        instead of leaving them invisible; each ``drain_width_ready``
+        event carries the measured wall seconds of its width's
+        lower+compile, and the width's ``cost_analysis`` FLOPs feed the
+        MFU accounting exactly per dispatch width."""
         t = self.trainer
+        mon = get_device_monitor()
         try:
             b0 = int(np.shape(staged_example.seq.reward)[0])
             # ONE width-1 placement yields the layout (dtype + sharding
@@ -1133,12 +1148,25 @@ class FleetLearner:
                     ),
                     base_avals,
                 )
-                compiled = self._drain_prog.lower(
-                    ls_avals, staged_avals
-                ).compile()
+                t_compile = time.monotonic()
+                with mon.expected("drain_warm"), mon.program(
+                    "fleet_drain_warm"
+                ):
+                    compiled = self._drain_prog.lower(
+                        ls_avals, staged_avals
+                    ).compile()
+                compile_s = time.monotonic() - t_compile
+                width_flops = flops_of(compiled)
+                if width_flops:
+                    self._drain_flops[w * b0] = width_flops
                 self._drain_exec[w * b0] = compiled
                 self._coalesce_ready = w
-                flight_event("drain_width_ready", width=w, seqs=w * b0)
+                flight_event(
+                    "drain_width_ready",
+                    width=w,
+                    seqs=w * b0,
+                    seconds=round(compile_s, 3),
+                )
                 w *= 2
         except Exception as e:  # noqa: BLE001 — degrade, never crash the run
             flight_event(
@@ -1178,6 +1206,11 @@ class FleetLearner:
         if self.server.address is None:
             raise RuntimeError("call start() before run()")
         t = self.trainer
+        # Device plane (ISSUE 14): the drain loop owns the run window —
+        # steady arms at the existing mark_steady boundary (first
+        # drain-learn executed AND warm-width compiles done).
+        mon = get_device_monitor().install()
+        mon.begin_run()
         state = t.init() if state is None else state
         cstate, lstate = split_state(state)
         deadline = (
@@ -1336,7 +1369,22 @@ class FleetLearner:
                     # The dp learner's dispatch-width gauge, set at the
                     # REAL drain site (host-known B — no fetch).
                     note_width(n_seqs)
-                with t.arena.staged_writer():
+                mon.on_phase(drained + 1)
+                if drained == drained_at_start:
+                    # MFU numerator for the uncoalesced/width-1 path: one
+                    # lazy lower() at these avals on the log cadence (the
+                    # warm thread's per-width cost_analysis overrides per
+                    # dispatch where it ran).
+                    ls_avals_c, st_avals_c = (
+                        aval_tree(lstate), aval_tree(placed),
+                    )
+                    mon.set_learn_cost(
+                        lambda: flops_of(
+                            self._drain_prog.lower(ls_avals_c, st_avals_c)
+                        )
+                    )
+                mon.note_learn(self._drain_flops.get(n_seqs))
+                with t.arena.staged_writer(), mon.program("fleet_drain"):
                     if exec_ is not None:
                         # AOT-precompiled width (the warm thread's
                         # contract): dispatch through the compiled object
@@ -1422,6 +1470,11 @@ class FleetLearner:
                     # on the real shed_after_s bound instead of the
                     # compile grace.
                     self.server.mark_steady()
+                    # The compile sentinel arms at the SAME boundary: the
+                    # drain programs (jit width-1 + every warm width) are
+                    # materialized — any later compile outside a declared
+                    # window is an aval-re-key alarm.
+                    mon.mark_steady()
                     marked_steady = True
                 if phase_fn is not None:
                     # The chaos engine's drain-clock hook (fleet/chaos.py):
@@ -1457,10 +1510,13 @@ class FleetLearner:
                     # The dp learner's per-shard gauges ride THIS batched
                     # fetch (Trainer._log_extra_refs — no fetches of
                     # their own on the hot path; ISSUE 9 obs satellite).
-                    extra = t._log_extra_refs(lstate.arena)
-                    lstep, m, *extra_vals = jax.device_get(
-                        (lstate.train.step, last_metrics, *extra)
-                    )
+                    # expected(): the extra refs build small eager
+                    # reductions on first use — declared, not an alarm.
+                    with mon.expected("log_fetch"):
+                        extra = t._log_extra_refs(lstate.arena)
+                        lstep, m, *extra_vals = jax.device_get(
+                            (lstate.train.step, last_metrics, *extra)
+                        )
                     if extra:
                         t._log_extra_publish(extra_vals)
                     scalars = {
@@ -1476,6 +1532,9 @@ class FleetLearner:
                     emit_log(drained, scalars)
         finally:
             jax.block_until_ready(lstate.train.step)
+            # Disarm the sentinel + close any open profiler capture:
+            # teardown/checkpoint compiles are a new window's business.
+            mon.end_run()
             # The run's honest end — BEFORE reaping the warm thread, so
             # a pending width compile can't inflate the measured walls.
             t_end = time.monotonic()
@@ -1552,6 +1611,10 @@ class FleetLearner:
                 "drain_coalesce_width_mean": (
                     coalesce_sum / max(coalesce_n, 1)
                 ),
+                # Device plane (ISSUE 14): this run's compile ledger +
+                # peak HBM — the bench columns, and what an evidence
+                # gate reads off the printed stats line.
+                **mon.run_stats(),
             }
             if train_t0 is not None:
                 # Steady-state window rates (the bench probe's keys): the

@@ -89,6 +89,7 @@ from r2d2dpg_tpu.fleet.ingest import (
 )
 from r2d2dpg_tpu.obs import flight_event, get_registry
 from r2d2dpg_tpu.obs import trace as obs_trace
+from r2d2dpg_tpu.obs.device import avals_of, flops_of, get_device_monitor
 from r2d2dpg_tpu.ops import anneal_beta, importance_weights
 from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
 from r2d2dpg_tpu.replay.sharded import (
@@ -763,6 +764,9 @@ class SamplerLearner:
             raise RuntimeError("call start() before run()")
         t = self.trainer
         cfg = t.config
+        # Device plane (ISSUE 14): the pull loop owns the run window.
+        mon = get_device_monitor().install()
+        mon.begin_run()
         state = t.init() if state is None else state
         cstate, lstate = split_state(state)
         train = lstate.train
@@ -837,6 +841,7 @@ class SamplerLearner:
                 if deadline is not None and time.monotonic() >= deadline:
                     break
                 fold_stats()
+                mon.on_phase(drained + 1)
                 tr = obs_trace.maybe_start(trace_sample)
                 t_req = time.time()
                 t_assemble = time.monotonic()
@@ -883,9 +888,21 @@ class SamplerLearner:
                     # scalars would otherwise default single-device).
                     size = jax.device_put(size, self._replicated)
                 rng, key = jax.random.split(rng)
-                train, prios_dev, last_metrics = self._learn_prog(
-                    train, seqs, probs, size, key
-                )
+                if drained == drained_at_start:
+                    # MFU numerator: one lazy lower() of the pull-learn
+                    # program at these avals, evaluated on the log
+                    # cadence — never a second backend compile.
+                    learn_avals = avals_of((train, seqs, probs, size, key))
+                    mon.set_learn_cost(
+                        lambda: flops_of(
+                            self._learn_prog.lower(*learn_avals)
+                        )
+                    )
+                mon.note_learn()
+                with mon.program("sampler_learn"):
+                    train, prios_dev, last_metrics = self._learn_prog(
+                        train, seqs, probs, size, key
+                    )
                 t_dispatch = time.time()
                 # ONE host fetch per phase: the write-back priorities
                 # must come back to the host-side shards (there is no
@@ -917,6 +934,9 @@ class SamplerLearner:
                     train_t0 = time.monotonic()
                 if not marked_steady:
                     self.server.mark_steady()
+                    # The pull-learn program is warm: the compile
+                    # sentinel arms (obs/device.py).
+                    mon.mark_steady()
                     marked_steady = True
                 if phase_fn is not None:
                     phase_fn(drained)
@@ -944,7 +964,10 @@ class SamplerLearner:
                     if log_every and drained % log_every == 0:
                         flight_event("param_publish", version=version)
                 if log_every and drained % log_every == 0:
-                    lstep, m = jax.device_get((train.step, last_metrics))
+                    with mon.expected("log_fetch"):
+                        lstep, m = jax.device_get(
+                            (train.step, last_metrics)
+                        )
                     scalars = {
                         "episode_return_mean": ep_ret_sum / max(ep_count, 1.0),
                         "episodes": ep_count,
@@ -959,6 +982,9 @@ class SamplerLearner:
                     emit_log(drained, scalars)
         finally:
             jax.block_until_ready(train.step)
+            # Sentinel disarmed + any open profiler capture closed before
+            # teardown's own device work runs.
+            mon.end_run()
             t_end = time.monotonic()
             fold_stats()
             wall = max(t_end - t0, 1e-9)
@@ -1018,6 +1044,8 @@ class SamplerLearner:
                 "overlap_fraction": max(
                     0.0, 1.0 - (sw_total + sa_total) / wall
                 ),
+                # Device plane (ISSUE 14): compile ledger + peak HBM.
+                **mon.run_stats(),
             }
             if self._remote:
                 # The standalone tier's robustness ledger (ISSUE 12).

@@ -18,6 +18,11 @@ One process-wide namespace for every subsystem's operator signals:
 - ``trace``     — sampled experience-path hop spans (collect -> ... ->
   learn) feeding ``r2d2dpg_trace_*_seconds`` histograms and the flight
   recorder's ``trace.json`` dump.
+- ``device``    — the device plane (ISSUE 14): compile sentinel
+  (``steady_recompile`` alarms on post-warm aval re-keys), per-device
+  HBM gauges, MFU against ``--device-peak-flops``, and
+  ``--profile-window`` profiler captures stamped into the fused
+  timeline.
 - ``RemoteMirror`` / ``allgather_into_mirror`` — other processes'
   registry snapshots merged into this process's exporter: ONE scrape
   point per fleet (fed by fleet TELEM frames or an SPMD allgather).
@@ -26,6 +31,11 @@ See docs/OBSERVABILITY.md for the naming scheme, endpoints, event schema
 and thresholds.
 """
 
+from r2d2dpg_tpu.obs import device  # noqa: F401 - obs.device.* is the API
+from r2d2dpg_tpu.obs.device import (
+    DeviceMonitor,
+    get_device_monitor,
+)
 from r2d2dpg_tpu.obs.exporter import (
     MetricsExporter,
     current_exporter,
@@ -63,6 +73,7 @@ from r2d2dpg_tpu.obs.watchdog import (
 
 __all__ = [
     "Counter",
+    "DeviceMonitor",
     "DivergenceError",
     "DivergenceWatchdog",
     "FlightRecorder",
@@ -76,7 +87,9 @@ __all__ = [
     "WatchdogConfig",
     "allgather_into_mirror",
     "current_exporter",
+    "device",
     "flight_event",
+    "get_device_monitor",
     "get_flight_recorder",
     "get_registry",
     "get_remote_mirror",

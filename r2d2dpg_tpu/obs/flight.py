@@ -40,7 +40,11 @@ AND the span dumps (the learner's Chrome-format ``trace.json`` plus the
 shard procs' ``trace_shard<i>.jsonl`` span rings) — and ``--trace-out``
 folds every discovered span source into ONE Perfetto timeline spanning
 learner + actors + shard procs (ISSUE 13), each span keeping a ``file``
-source stamp on top of its identity fields.
+source stamp on top of its identity fields.  Device-plane profiler
+captures (``--profile-window``, obs/device.py) appear in the fused
+timeline too: ``profile_start``/``profile_stop`` event pairs become
+labelled ``profile_window`` spans (``profile_window_spans``), so the
+capture's phase coverage is readable off the timeline itself.
 
 Hard crashes (SIGSEGV & friends) cannot run Python: ``install()`` also
 points ``faulthandler`` at a sidecar ``<path>.fault`` file so native
@@ -452,6 +456,60 @@ def load_spans(paths: Iterable[str]) -> Tuple[List[Dict], int]:
     return sort_by_twall(spans), skipped
 
 
+def profile_window_spans(events: Iterable[Dict]) -> List[Dict]:
+    """Pair ``profile_start``/``profile_stop`` flight events (the device
+    plane's ``--profile-window`` capture brackets, obs/device.py) into
+    labelled ``profile_window`` spans for the fused Perfetto timeline —
+    the capture window is visible IN the timeline it profiles, so "which
+    phases does this trace cover" is answered by the evidence itself.
+
+    Pairing is per (file, pid): each process's own start matches its own
+    stop; an unmatched start (the run died mid-capture) still yields a
+    zero-duration marker span so the attempt is never invisible."""
+    spans: List[Dict] = []
+    open_starts: Dict[Tuple, Dict] = {}
+    for e in sort_by_twall(events):
+        if not isinstance(e, dict):
+            continue
+        key = (e.get("file"), e.get("pid"))
+        if e.get("kind") == "profile_start":
+            open_starts[key] = e
+        elif e.get("kind") == "profile_stop":
+            s = open_starts.pop(key, None)
+            if s is None:
+                continue
+            spans.append(
+                {
+                    "hop": "profile_window",
+                    "trace_id": 0,
+                    "t_wall": float(s.get("t_wall", 0.0)),
+                    "dur_s": max(
+                        float(e.get("t_wall", 0.0))
+                        - float(s.get("t_wall", 0.0)),
+                        0.0,
+                    ),
+                    "pid": int(e.get("pid", 0) or 0),
+                    "file": e.get("file"),
+                    "phase": s.get("phase"),
+                    "logdir": s.get("logdir"),
+                }
+            )
+    for key, s in open_starts.items():
+        spans.append(
+            {
+                "hop": "profile_window",
+                "trace_id": 0,
+                "t_wall": float(s.get("t_wall", 0.0)),
+                "dur_s": 0.0,
+                "pid": int(s.get("pid", 0) or 0),
+                "file": s.get("file"),
+                "phase": s.get("phase"),
+                "unterminated": True,
+            }
+        )
+    return spans
+
+
 def merge_flight_files(paths: Iterable[str]) -> Tuple[List[Dict], int]:
     """N processes' flight dumps -> one ``t_wall``-ordered fleet timeline,
     plus the count of lines that could not be parsed.
@@ -531,12 +589,16 @@ def main(argv=None) -> None:
     )
     if not paths and not args.trace_out:
         raise SystemExit("flight merge: no flight*.jsonl files found")
-    # The event merge runs only when its product goes somewhere: -o, or
-    # stdout when events are the REQUESTED product (a --trace-out run
-    # without -o is asking for the timeline, and merging megabytes of
-    # flight lines to discard them would be pure waste).
-    if paths and (args.out or args.trace_out is None):
+    # ONE event merge feeds both consumers — the -o/stdout timeline AND
+    # the --trace-out profile-window pairing below (re-reading megabytes
+    # of flight lines per consumer would be pure waste); it is skipped
+    # entirely only when nothing consumes events (no paths, or a
+    # --trace-out-without--o run on a dir with no flight dumps).
+    merged: List[Dict] = []
+    skipped = 0
+    if paths:
         merged, skipped = merge_flight_files(paths)
+    if paths and (args.out or args.trace_out is None):
         body = "".join(json.dumps(e, default=str) + "\n" for e in merged)
         skip_note = (
             f" ({skipped} unparseable lines skipped)" if skipped else ""
@@ -591,6 +653,12 @@ def main(argv=None) -> None:
             seen_abs.add(q_abs)
             trace_paths.append(q)
         spans, tskipped = load_spans(trace_paths)
+        if merged:
+            # Profiler capture windows (obs/device.py --profile-window):
+            # the start/stop flight events become labelled profile_window
+            # spans, so the fused timeline shows WHICH phases the
+            # profiler dump under <logdir>/profile_window covers.
+            spans = sort_by_twall(spans + profile_window_spans(merged))
         if not spans:
             raise SystemExit(
                 "flight merge: --trace-out found no spans (no "

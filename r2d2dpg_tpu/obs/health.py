@@ -31,6 +31,16 @@ evidence — docs/FLEET.md / docs/REPLAY.md failure matrices):
 - ``actors_down``        live supervised actors below the spawn target.
 - ``shards_down``        live shard processes below the spawn target
   (``critical`` when zero: sampling is fully degraded).
+- ``recompile_churn``    new ``steady_recompile`` sentinel trips inside
+  the evaluation window (obs/device.py): a learn/drain program's avals
+  re-keyed after warm-up — the silent-compile-stall bug class, live.
+  Warm-up compiles never increment the counter (the sentinel arms at
+  ``mark_steady``), so the rule is warm-up-exempt by construction, and
+  it CLEARS once a full window passes with no new trips.
+- ``hbm_pressure``       a device's ``bytes_in_use`` over the headroom
+  fraction of its ``bytes_limit``: the next drain width or batch bump
+  OOMs.  Backends without allocator limits (CPU fallback) register no
+  limit series, so absence of evidence stays non-degrading.
 
 The verdict is the max severity across findings; every verdict
 TRANSITION lands in the flight ring (``health_verdict`` events), so a
@@ -78,6 +88,8 @@ RULES = (
     "eviction_churn",
     "actors_down",
     "shards_down",
+    "recompile_churn",
+    "hbm_pressure",
     # The synthetic finding a raising rule degrades into (never a 500):
     # exported like the real rules so a degraded verdict is always
     # attributable to SOME firing series on the scrape.
@@ -106,6 +118,16 @@ class HealthConfig:
     occupancy_skew_min_mean: float = 64.0
     expected_actors: int = 0  # 0 = rule disarmed
     expected_shard_procs: int = 0  # 0 = rule disarmed
+    # Device plane (obs/device.py).  recompile_churn fires on ANY new
+    # steady_recompile inside a window at the 0.0 default — one post-warm
+    # re-key is already the bug class the sentinel exists for; polls
+    # closer than the min dt re-judge the last full window (the
+    # eviction_churn burst guard, same rationale).
+    steady_recompiles_per_window: float = 0.0
+    recompile_rate_min_dt_s: float = 5.0
+    # hbm_pressure: in_use over this fraction of the device's reported
+    # bytes_limit reads as "the next allocation bump OOMs".
+    hbm_pressure_frac: float = 0.92
     # Staleness gauges arm at HELLO whether or not the peers were told to
     # push TELEM (actor/shard --telem-every rides --obs-fleet): on a run
     # without it every clock grows forever, and firing telem_stale there
@@ -177,12 +199,16 @@ class HealthEngine:
         self._last_verdict: Optional[str] = None
         self._evict_last: Optional[tuple] = None  # (t_mono, total)
         self._evict_rate: Optional[float] = None  # last full-window rate
+        self._recompile_last: Optional[tuple] = None  # (t_mono, total)
+        self._recompile_new: Optional[float] = None  # last full window's new
         self._rules = (
             self._rule_learner_starving,
             self._rule_telem_stale,
             self._rule_shard_skew,
             self._rule_eviction_churn,
             self._rule_procs_down,
+            self._rule_recompile_churn,
+            self._rule_hbm_pressure,
         )
         reg = self.registry
         self._obs_status = reg.gauge(
@@ -320,6 +346,88 @@ class HealthEngine:
                     "threshold": self.config.eviction_churn_per_s,
                 }
             )
+
+    def _rule_recompile_churn(self, snap, findings) -> None:
+        samples = _samples(
+            snap, "r2d2dpg_device_steady_recompiles_total"
+        )
+        if not samples:
+            return  # no device monitor in this process: rule disarmed
+        total = max(
+            (v for v in (_finite(s.get("value")) for s in samples)
+             if v is not None),
+            default=None,
+        )
+        if total is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            last = self._recompile_last
+            if (
+                last is not None
+                and now - last[0] < self.config.recompile_rate_min_dt_s
+            ):
+                # Sub-window poll gap: re-judge the last FULL window (the
+                # eviction_churn burst guard) so an operator curl racing
+                # the autoscaler cannot flap the verdict.
+                new = self._recompile_new
+            else:
+                if last is not None:
+                    self._recompile_new = max(total - last[1], 0.0)
+                else:
+                    # First sighting: a counter that is ALREADY nonzero
+                    # is live evidence (the drill fired before the first
+                    # /health poll), not a rate — judge the absolute
+                    # total, and keep judging it (sub-window re-polls
+                    # included) until a full quiet window clears it.
+                    self._recompile_new = total
+                self._recompile_last = (now, total)
+                new = self._recompile_new
+        if new is None:
+            return
+        if new > self.config.steady_recompiles_per_window:
+            findings.append(
+                {
+                    "rule": "recompile_churn",
+                    "severity": VERDICT_DEGRADED,
+                    "detail": "steady-state recompiles: a learn/drain "
+                    "program's avals re-keyed after warm-up (see "
+                    "steady_recompile flight events for the program "
+                    "label) — each one is a silent multi-second stall",
+                    "value": new,
+                    "threshold": self.config.steady_recompiles_per_window,
+                }
+            )
+
+    def _rule_hbm_pressure(self, snap, findings) -> None:
+        limits: Dict[object, float] = {}
+        for s in _samples(snap, "r2d2dpg_device_hbm_bytes_limit"):
+            v = _finite(s.get("value"))
+            labels = s.get("labels")
+            if v and v > 0 and isinstance(labels, dict):
+                limits[labels.get("device")] = v
+        if not limits:
+            return  # CPU fallback reports no capacity: never degrading
+        for s in _samples(snap, "r2d2dpg_device_hbm_bytes_in_use"):
+            v = _finite(s.get("value"))
+            labels = s.get("labels")
+            if v is None or not isinstance(labels, dict):
+                continue
+            limit = limits.get(labels.get("device"))
+            if limit is None:
+                continue
+            if v > self.config.hbm_pressure_frac * limit:
+                findings.append(
+                    {
+                        "rule": "hbm_pressure",
+                        "severity": VERDICT_DEGRADED,
+                        "detail": f"device {labels.get('device')} HBM in "
+                        "use over the headroom threshold — the next "
+                        "drain-width/batch allocation bump OOMs",
+                        "value": v,
+                        "threshold": self.config.hbm_pressure_frac * limit,
+                    }
+                )
 
     def _rule_procs_down(self, snap, findings) -> None:
         for name, rule, expected in (

@@ -239,6 +239,24 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--eval-envs", type=int, default=10)
     # Debug / profiling.
     p.add_argument("--profile-phases", type=int, default=0, help="trace this many train phases into --logdir/profile")
+    p.add_argument(
+        "--profile-window", default=None, metavar="P:N",
+        help="device-plane profiler capture (obs/device.py): run "
+        "jax.profiler for N train/drain phases starting at phase P into "
+        "<logdir>/profile_window, on WHICHEVER learner loop the run "
+        "resolves to (phase-locked, pipelined, fleet drain, sampler "
+        "pull).  profile_start/profile_stop flight events bracket the "
+        "capture, and 'obs.flight merge --trace-out' stamps it as a "
+        "labelled profile_window span in the fused Perfetto timeline.  "
+        "Mutually exclusive with --profile-phases (one jax profiler "
+        "session per process); requires --logdir"
+    )
+    p.add_argument(
+        "--device-peak-flops", type=float, default=0.0, metavar="FLOPS",
+        help="the accelerator's peak FLOP/s for the r2d2dpg_device_mfu "
+        "gauge (e.g. 1.97e14 for a TPU v5p core-pair at bf16).  0 = "
+        "unknown: the gauge stays 0 rather than inventing a denominator"
+    )
     p.add_argument("--nan-debug", action="store_true")
     # Observability (docs/OBSERVABILITY.md).
     p.add_argument(
@@ -494,6 +512,34 @@ def run(args) -> dict:
     # opt-in; the watchdog is on by default (--watchdog 0 to drop it).
     registry = obs.get_registry()
     flight = obs.get_flight_recorder()
+    # Device plane (ISSUE 14, docs/OBSERVABILITY.md "Device plane"):
+    # compile sentinel + HBM/MFU gauges are always armed (the listener is
+    # ~free; gauges ride the log cadence); the profiler window is opt-in.
+    device_mon = obs.get_device_monitor().install()
+    device_mon.configure(peak_flops=args.device_peak_flops)
+    if args.profile_window is not None:
+        if args.profile_phases:
+            raise SystemExit(
+                "--profile-window and --profile-phases both drive the one "
+                "jax profiler session this process has — pick one "
+                "(--profile-window works on every learner loop and is "
+                "the superset)"
+            )
+        if not args.logdir:
+            raise SystemExit("--profile-window requires --logdir")
+        try:
+            pw_phase, pw_steps = device_mon.arm_profile(
+                args.profile_window,
+                os.path.join(args.logdir, "profile_window"),
+            )
+        except ValueError as e:
+            raise SystemExit(f"--profile-window: {e}")
+        print(
+            f"obs: profiler capture armed for phases "
+            f"{pw_phase}..{pw_phase + pw_steps - 1} -> "
+            f"{args.logdir}/profile_window",
+            flush=True,
+        )
     # Identity stamp (docs/FLEET.md post-mortems): every event this process
     # records says which host of a multi-process fleet it came from, so
     # interleaved flight.jsonl dumps stay attributable.
@@ -618,6 +664,7 @@ def run(args) -> dict:
     )
     profile_until = None
     profiler_cm = None
+    device_mon.begin_run()
 
     try:
         while True:
@@ -641,10 +688,29 @@ def run(args) -> dict:
                     profile_until = phase + args.profile_phases
                     profiler_cm = profile_trace(f"{args.logdir}/profile")
                     profiler_cm.__enter__()
-                state, last_learn = trainer.train_phase(state)
+                device_mon.on_phase(train_phases_done + 1)
+                if train_phases_done == 0:
+                    # MFU numerator: one lazy lower() of the fused phase
+                    # at these avals, evaluated on the log cadence.
+                    st_avals = obs.device.avals_of(state)
+                    device_mon.set_learn_cost(
+                        lambda: obs.device.flops_of(
+                            trainer.train_phase.lower(st_avals)
+                        )
+                    )
+                with device_mon.program("train_phase"):
+                    state, last_learn = trainer.train_phase(state)
+                device_mon.note_learn()
                 train_phases_done += 1
+                if train_phases_done == 1:
+                    # The fused phase program is warm: the compile
+                    # sentinel arms — a post-steady compile outside a
+                    # declared window (log fetch, eval, drills) is the
+                    # aval-re-key alarm (docs/OBSERVABILITY.md).
+                    device_mon.mark_steady()
                 if train_phases_done == args.nan_inject_phase:
-                    state = _poison_actor_params(state)
+                    with device_mon.expected("nan_inject"):
+                        state = _poison_actor_params(state)
                 if profiler_cm is not None and phase + 1 >= profile_until:
                     jax.block_until_ready(state.train.step)
                     profiler_cm.__exit__(None, None, None)
@@ -652,13 +718,17 @@ def run(args) -> dict:
             phase += 1
 
             if args.log_every and phase % args.log_every == 0:
-                state, ep = trainer.pop_episode_metrics(state)
-                scalars = dict(ep)
-                # ONE batched fetch for learn metrics + the step counter
-                # (per-scalar float() casts were N+1 blocking host syncs).
-                learn_np, lstep = jax.device_get(
-                    (last_learn, state.train.step)
-                )
+                # expected(): the log fetch builds small eager reductions
+                # on first use — declared, never a sentinel alarm.
+                with device_mon.expected("log_fetch"):
+                    state, ep = trainer.pop_episode_metrics(state)
+                    scalars = dict(ep)
+                    # ONE batched fetch for learn metrics + the step
+                    # counter (per-scalar float() casts were N+1 blocking
+                    # host syncs).
+                    learn_np, lstep = jax.device_get(
+                        (last_learn, state.train.step)
+                    )
                 scalars.update(
                     {k: float(v) for k, v in learn_np.items()}
                 )
@@ -692,7 +762,10 @@ def run(args) -> dict:
                 and (phase - fill) % args.eval_every == 0
             ):
                 eval_key, k = jax.random.split(eval_key)
-                ev = evaluator.run(state.train.actor_params, k)
+                # Eval compiles its own programs on first use: a declared
+                # window, not an aval re-key of the training chain.
+                with device_mon.expected("eval"):
+                    ev = evaluator.run(state.train.actor_params, k)
                 # Stamp the monotone env-step counter so eval-vs-steps
                 # curves read directly off the CSV/TB row.
                 ev["env_steps"] = float(state.env_steps)
@@ -702,6 +775,9 @@ def run(args) -> dict:
         diverged = True
         _abort_on_divergence(e, flight, flight_path, ckpt)
     finally:
+        # Sentinel disarmed FIRST: the final save / logger close below
+        # belong to teardown, not the steady window.
+        device_mon.end_run()
         if profiler_cm is not None:
             profiler_cm.__exit__(None, None, None)
         if ckpt is not None:

@@ -64,6 +64,7 @@ import numpy as np
 
 from r2d2dpg_tpu.obs import flight_event, get_registry
 from r2d2dpg_tpu.obs import trace as obs_trace
+from r2d2dpg_tpu.obs.device import avals_of, flops_of, get_device_monitor
 from r2d2dpg_tpu.replay.arena import StagedSequences
 from r2d2dpg_tpu.training.assembler import emit
 from r2d2dpg_tpu.training.trainer import Trainer, TrainerState
@@ -532,6 +533,12 @@ class PipelineExecutor:
         cfg = self.config
         n_train = num_phases - phase0
         self._reset_stats()
+        # Device plane (ISSUE 14): the learner thread owns the run window
+        # — steady arms once the first drain executed, the profiler
+        # window ticks on drain phases, and the collector thread's
+        # compiles carry their own label.
+        mon = get_device_monitor().install()
+        mon.begin_run()
         cstate, lstate = split_state(state)
         box = _ParamBox(None, None)
         self._publish(box, lstate.train, phase0)
@@ -547,6 +554,7 @@ class PipelineExecutor:
 
         def collector() -> None:
             cs = cstate
+            mon.label_thread("pipeline_collect")
             try:
                 behavior, critic = box.snapshot()
                 for k in range(n_train):
@@ -638,8 +646,22 @@ class PipelineExecutor:
                     break
                 gphase, staged, ep_refs, tr = item
                 t_dequeue = time.time()
-                with annotate("pipeline/learn"):
+                mon.on_phase(drained + 1)
+                if drained == 0:
+                    # MFU numerator: one lazy lower() at these avals,
+                    # evaluated on the log cadence — never a second
+                    # backend compile, never on this first hot dispatch.
+                    ls_avals, st_avals = avals_of(ls), avals_of(staged)
+                    mon.set_learn_cost(
+                        lambda: flops_of(
+                            self._drain_prog.lower(ls_avals, st_avals)
+                        )
+                    )
+                with annotate("pipeline/learn"), mon.program(
+                    "pipeline_drain"
+                ):
                     ls, metrics = self._drain_prog(ls, staged)
+                mon.note_learn()
                 if tr is not None:
                     # Sampled batch: enqueue = staging-queue residency,
                     # arena_add = the drain call's dispatch window, learn =
@@ -660,6 +682,11 @@ class PipelineExecutor:
                     box, ls.train, gphase, record=ep_refs is not None
                 )
                 drained += 1
+                if drained == 1:
+                    # Drain + collect + publish programs are all warm
+                    # (the publish's eager copies compiled at the
+                    # pre-loop publish): the sentinel arms.
+                    mon.mark_steady()
                 if ep_refs is not None:
                     # ONE batched fetch per log cadence: episode stats,
                     # learner step counter, the phase's learn metrics, and
@@ -668,15 +695,16 @@ class PipelineExecutor:
                     # pop_episode_metrics: a multi-process fleet's arena is
                     # not fully addressable per process, so eager
                     # reductions on it are skipped.
-                    refs = [*ep_refs, ls.train.step, metrics]
-                    single_proc = jax.process_count() == 1
-                    if single_proc:
-                        refs += [
-                            t.arena.size(ls.arena),
-                            ls.arena.priority.sum(),
-                            ls.arena.total_added,
-                        ]
-                    fetched = jax.device_get(tuple(refs))
+                    with mon.expected("log_fetch"):
+                        refs = [*ep_refs, ls.train.step, metrics]
+                        single_proc = jax.process_count() == 1
+                        if single_proc:
+                            refs += [
+                                t.arena.size(ls.arena),
+                                ls.arena.priority.sum(),
+                                ls.arena.total_added,
+                            ]
+                        fetched = jax.device_get(tuple(refs))
                     env_steps, ret_sum, count, lstep, m = fetched[:5]
                     count = float(count)
                     ep = {
@@ -709,6 +737,9 @@ class PipelineExecutor:
             # (b) pin the queue's device-resident payloads until the next
             # section rebinds it.
             self._obs_queue_depth.set(0.0)
+            # Disarm the sentinel (and close any open profiler capture):
+            # whatever compiles after this section is a new window.
+            mon.end_run()
         if collector_err:
             raise collector_err[0]
         jax.block_until_ready(ls.train.step)
@@ -730,5 +761,8 @@ class PipelineExecutor:
             "overlap_fraction": float(
                 np.clip(1.0 - lw_total / wall, 0.0, 1.0)
             ),
+            # Device plane (ISSUE 14): this section's compile ledger +
+            # peak HBM — the bench/evidence columns.
+            **mon.run_stats(),
         }
         return merge_state(state, result["cstate"], ls, behavior_final)

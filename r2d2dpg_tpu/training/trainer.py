@@ -121,7 +121,12 @@ class Trainer:
         # Telemetry (obs/): registration is idempotent, so repeated Trainer
         # constructions (tests, eval) share one instrument per name.
         from r2d2dpg_tpu.obs import get_registry
+        from r2d2dpg_tpu.obs.device import get_device_monitor
 
+        # The device plane (ISSUE 14): ONE process monitor shared by every
+        # loop this trainer may run under — compile sentinel, HBM/MFU
+        # gauges riding the log cadence via _obs_publish.
+        self._device = get_device_monitor().install()
         reg = get_registry()
         self._obs_env_steps = reg.gauge(
             "r2d2dpg_trainer_env_steps", "fleet-wide env steps collected"
@@ -594,6 +599,9 @@ class Trainer:
             self._obs_learner_steps.set(metrics["learner_steps"])
         if metrics.get("episodes"):
             self._obs_episodes.inc(metrics["episodes"])
+        # Device-plane gauges (HBM in-use/peak, the MFU window) refresh on
+        # the same cadence — host-side allocator reads, no device syncs.
+        self._device.publish()
 
     # ----------------------------------------------------------- main loop
     def run(
@@ -607,31 +615,73 @@ class Trainer:
         state = self.init() if state is None else state
         warm, fill = self.window_fill_phases, self.replay_fill_phases
         last_metrics: Dict[str, jnp.ndarray] = {}
-        for phase in range(num_phases):
-            # annotate(): host-side trace regions around each phase dispatch
-            # so the TB profiler timeline separates the schedule stages.
-            if phase < warm:
-                with annotate("trainer/collect_phase"):
-                    state = self.collect_phase(state)
-            elif phase < warm + fill:
-                with annotate("trainer/fill_phase"):
-                    state = self.fill_phase(state)
-            else:
-                with annotate("trainer/train_phase"):
-                    state, last_metrics = self.train_phase(state)
-            if log_every and (phase + 1) % log_every == 0:
-                state, ep = self.pop_episode_metrics(state)
-                # One batched fetch for the learn metrics too (a float()
-                # per metric would be N more blocking host syncs).
-                scalars = {
-                    k: float(v)
-                    for k, v in jax.device_get(last_metrics).items()
-                }
-                log_fn(
-                    f"phase {phase + 1}/{num_phases} "
-                    f"env_steps {int(ep['env_steps'])} "
-                    f"return {ep['episode_return_mean']:.1f} "
-                    f"({int(ep['episodes'])} eps) "
-                    + " ".join(f"{k} {v:.3g}" for k, v in scalars.items())
-                )
+        mon = self._device
+        mon.begin_run()
+        train_done = 0
+        try:
+            for phase in range(num_phases):
+                # annotate(): host-side trace regions around each phase
+                # dispatch so the TB profiler timeline separates the
+                # schedule stages.
+                if phase < warm:
+                    with annotate("trainer/collect_phase"):
+                        state = self.collect_phase(state)
+                elif phase < warm + fill:
+                    with annotate("trainer/fill_phase"):
+                        state = self.fill_phase(state)
+                else:
+                    mon.on_phase(train_done + 1)
+                    if train_done == 0:
+                        from r2d2dpg_tpu.obs.device import flops_of
+
+                        # MFU numerator: ONE lazy lower() of the fused
+                        # train phase at these avals, evaluated on the log
+                        # cadence (never a second backend compile).
+                        st_avals = self._device_avals(state)
+                        mon.set_learn_cost(
+                            lambda: flops_of(
+                                self.train_phase.lower(st_avals)
+                            )
+                        )
+                    with annotate("trainer/train_phase"), mon.program(
+                        "train_phase"
+                    ):
+                        state, last_metrics = self.train_phase(state)
+                    mon.note_learn()
+                    train_done += 1
+                    if train_done == 1:
+                        # The fused phase program is warm: any later
+                        # compile outside a declared window is an
+                        # aval-re-key alarm (docs/OBSERVABILITY.md
+                        # "Device plane").
+                        mon.mark_steady()
+                if log_every and (phase + 1) % log_every == 0:
+                    # The log fetch builds small eager reductions on
+                    # first use — declared, never an alarm.
+                    with mon.expected("log_fetch"):
+                        state, ep = self.pop_episode_metrics(state)
+                        # One batched fetch for the learn metrics too (a
+                        # float() per metric would be N more blocking
+                        # host syncs).
+                        scalars = {
+                            k: float(v)
+                            for k, v in jax.device_get(last_metrics).items()
+                        }
+                    log_fn(
+                        f"phase {phase + 1}/{num_phases} "
+                        f"env_steps {int(ep['env_steps'])} "
+                        f"return {ep['episode_return_mean']:.1f} "
+                        f"({int(ep['episodes'])} eps) "
+                        + " ".join(
+                            f"{k} {v:.3g}" for k, v in scalars.items()
+                        )
+                    )
+        finally:
+            mon.end_run()
         return state
+
+    def _device_avals(self, tree):
+        """Aval capture for the device monitor's lazy cost analysis."""
+        from r2d2dpg_tpu.obs.device import avals_of
+
+        return avals_of(tree)

@@ -156,6 +156,10 @@ run_evidence() {
         echo "$dir: composed-topology gate FAILED (attempt $attempt)"
         continue
       fi
+      if ! device_gate "$dir" "$@"; then
+        echo "$dir: device-plane gate FAILED (attempt $attempt)"
+        continue
+      fi
       timeout --kill-after=30 --signal=TERM 1800 \
         env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
         python -m r2d2dpg_tpu.eval $evalflags \
@@ -548,6 +552,41 @@ topology_gate() {
     return 0
   fi
   return 1
+}
+
+# Device-plane gate (ISSUE 14): NO evidence dir may be blessed (.done)
+# while any of its flight dumps carries a steady_recompile event — a
+# learn/drain program whose avals re-keyed after warm-up recompiled
+# mid-measurement, so every rate in the dir includes a silent multi-
+# second stall the record doesn't explain (the exact bug class the
+# PR 9/11 out_shardings pins exist to prevent; obs/device.py is the
+# sentinel).  Applies to EVERY run shape — the phase-locked loop arms
+# the sentinel too — and re-runs on every gate pass (a cheap grep; no
+# stamp file to go stale).  The verdict is stamped device_obs.txt
+# beside topology.txt either way, so a blessed number always says its
+# steady window was compile-clean.  Runs predating the sentinel leave
+# no flight dumps with the event and pass through unchanged.
+#   device_gate <dir> <train args...>
+device_gate() {
+  local dir=$1
+  shift
+  local f n hits=0 dumps=0
+  for f in "$dir"/flight*.jsonl; do
+    [ -f "$f" ] || continue
+    dumps=$((dumps + 1))
+    n=$(grep -c '"kind": "steady_recompile"' "$f")
+    hits=$((hits + ${n:-0}))
+  done
+  printf 'steady_recompiles=%s flight_dumps=%s\n' "$hits" "$dumps" \
+    > "$dir/device_obs.txt"
+  if [ "$hits" -gt 0 ]; then
+    echo "$dir: device_gate: $hits steady_recompile event(s) in the" \
+         "run's flight dumps — a learn/drain program re-keyed mid-run" \
+         "(grep steady_recompile $dir/flight*.jsonl for the program" \
+         "labels); compile-stalled rates cannot be blessed as evidence"
+    return 1
+  fi
+  return 0
 }
 
 gate_on_box() {

@@ -74,6 +74,24 @@ if Path("r2d2dpg_tpu/obs/trace.py").exists():
         name = f"r2d2dpg_trace_{hop}_seconds"
         if not scheme.match(name) and name not in allow:
             bad.append(f"r2d2dpg_tpu/obs/trace.py (hop {hop!r}): {name}")
+# The device-plane family (obs/device.py METRIC_NAMES, ISSUE 14): the
+# module enumerates its namespace, so the scheme check covers every
+# r2d2dpg_device_* name even if a registration ever goes non-literal —
+# and a name added to the module without joining METRIC_NAMES is itself
+# an offence (the enumeration IS the documented contract).
+if Path("r2d2dpg_tpu/obs/device.py").exists():
+    from r2d2dpg_tpu.obs.device import METRIC_NAMES  # noqa: E402
+
+    for name in METRIC_NAMES:
+        if not scheme.match(name) and name not in allow:
+            bad.append(f"r2d2dpg_tpu/obs/device.py: {name}")
+    declared = set(METRIC_NAMES)
+    for name in pat.findall(Path("r2d2dpg_tpu/obs/device.py").read_text()):
+        if name.startswith("r2d2dpg_device_") and name not in declared:
+            bad.append(
+                f"r2d2dpg_tpu/obs/device.py: {name} registered but "
+                "missing from METRIC_NAMES"
+            )
 if bad:
     print("\n".join(bad))
     print(

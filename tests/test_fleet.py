@@ -90,6 +90,14 @@ def test_fleet_off_determinism_bit_identical(
     assert (N_TRAIN, LOG_EVERY) == (10, 3)  # the k10 fixture's recipe
     s1 = phase_locked_reference_k10
 
+    # Device-plane rider (ISSUE 14): the anchor's CLI run must complete
+    # with ZERO compile-sentinel alarms — the default schedule is the
+    # aval-stability baseline every other loop is measured against.
+    from r2d2dpg_tpu.obs import get_device_monitor, get_flight_recorder
+
+    recompiles0 = get_device_monitor()._steady_recompiles_total
+    events0 = get_flight_recorder().recorded_total
+
     train.run(
         train.parse_args(
             [
@@ -103,6 +111,16 @@ def test_fleet_off_determinism_bit_identical(
             ]
         )
     )
+    assert get_device_monitor()._steady_recompiles_total == recompiles0, (
+        "the phase-locked CLI anchor tripped the compile sentinel — a "
+        "post-steady program re-key in the default schedule"
+    )
+    assert not [
+        e
+        for e in get_flight_recorder().events()
+        if e["kind"] == "steady_recompile"
+        and e.get("seq", 0) >= events0
+    ]
     t2 = PENDULUM_TINY.build()
     s2 = resume_state(
         t2, CheckpointManager(str(tmp_path / "ckpt"), save_every=-1)

@@ -1426,6 +1426,124 @@ def test_health_engine_procs_down_and_transition_events():
     assert reg.get("r2d2dpg_health_transitions_total").value == 4.0
 
 
+def test_health_engine_recompile_churn_fire_clear_and_warmup_exempt():
+    """recompile_churn (ISSUE 14): new steady_recompile sentinel trips
+    inside a window fire; a quiet full window clears; warm-up compiles
+    (which grow compile_total but never the steady counter — the
+    sentinel arms at mark_steady) are exempt by construction."""
+    reg, engine = _snap_engine(recompile_rate_min_dt_s=0.0)
+    import time as _time
+
+    # Absence: no device monitor in this process -> rule disarmed.
+    assert engine.evaluate()["verdict"] == "ok"
+    # Warm-up-exempt: compile activity alone (the warm-up counter) never
+    # fires the rule — only the steady counter is judged.
+    reg.counter(
+        "r2d2dpg_device_compile_total", labelnames=("program",)
+    ).labels(program="warmup").inc(50)
+    steady = reg.counter("r2d2dpg_device_steady_recompiles_total")
+    assert not [
+        f
+        for f in engine.evaluate()["findings"]
+        if f["rule"] == "recompile_churn"
+    ]
+    # A trip that landed BEFORE the first poll is live evidence, not a
+    # rate: judged on the absolute total at first sighting.
+    _time.sleep(0.01)
+    steady.inc()
+    res = engine.evaluate()
+    fired = [f for f in res["findings"] if f["rule"] == "recompile_churn"]
+    assert fired and res["verdict"] == "degraded"
+    assert fired[0]["value"] == 1.0
+    # A full quiet window clears the finding (the counter is monotone;
+    # the rule judges NEW trips per window, not the total).
+    _time.sleep(0.01)
+    engine.evaluate()  # window with no new trips -> rate 0 recorded
+    _time.sleep(0.01)
+    assert not [
+        f
+        for f in engine.evaluate()["findings"]
+        if f["rule"] == "recompile_churn"
+    ]
+    # ...and a fresh trip re-fires.
+    steady.inc(2)
+    _time.sleep(0.01)
+    fired = [
+        f
+        for f in engine.evaluate()["findings"]
+        if f["rule"] == "recompile_churn"
+    ]
+    assert fired and fired[0]["value"] == 2.0
+    assert reg.get("r2d2dpg_health_rule_firing").labels(
+        rule="recompile_churn"
+    ).value == 1.0
+
+
+def test_health_engine_recompile_churn_rejudges_sub_window_polls():
+    """The burst guard (eviction_churn's rationale): polls closer than
+    the min dt re-judge the last FULL window instead of flapping."""
+    reg, engine = _snap_engine(recompile_rate_min_dt_s=5.0)
+    steady = reg.counter("r2d2dpg_device_steady_recompiles_total")
+    assert engine.evaluate()["verdict"] == "ok"  # baseline at 0
+    steady.inc()
+    # 0.0 s later (well under min dt): the last full window had no new
+    # trips -> still ok; the trip will be judged when a window elapses.
+    assert not [
+        f
+        for f in engine.evaluate()["findings"]
+        if f["rule"] == "recompile_churn"
+    ]
+
+
+def test_health_engine_hbm_pressure_fire_and_absent_limit_exempt():
+    """hbm_pressure (ISSUE 14): in_use over the headroom fraction of the
+    device's reported limit degrades; a backend with no limit series
+    (the CPU live-arrays fallback) stays non-degrading — absence of
+    evidence is never degradation."""
+    reg, engine = _snap_engine(hbm_pressure_frac=0.9)
+    in_use = reg.gauge(
+        "r2d2dpg_device_hbm_bytes_in_use", labelnames=("device",)
+    )
+    # CPU shape: in_use series, NO limit series -> exempt however full.
+    in_use.labels(device="0").set(1e12)
+    assert not [
+        f
+        for f in engine.evaluate()["findings"]
+        if f["rule"] == "hbm_pressure"
+    ]
+    limit = reg.gauge(
+        "r2d2dpg_device_hbm_bytes_limit", labelnames=("device",)
+    )
+    limit.labels(device="0").set(16e9)
+    in_use.labels(device="0").set(0.5 * 16e9)  # half full: headroom
+    assert not [
+        f
+        for f in engine.evaluate()["findings"]
+        if f["rule"] == "hbm_pressure"
+    ]
+    in_use.labels(device="0").set(0.95 * 16e9)  # over the 0.9 bar
+    res = engine.evaluate()
+    fired = [f for f in res["findings"] if f["rule"] == "hbm_pressure"]
+    assert fired and res["verdict"] == "degraded"
+    assert fired[0]["threshold"] == pytest.approx(0.9 * 16e9)
+    # Per-device: a second device under its own limit adds no finding.
+    limit.labels(device="1").set(16e9)
+    in_use.labels(device="1").set(1e9)
+    assert (
+        len(
+            [
+                f
+                for f in engine.evaluate()["findings"]
+                if f["rule"] == "hbm_pressure"
+            ]
+        )
+        == 1
+    )
+    # Recovery clears (pull-time rule, no sticky state).
+    in_use.labels(device="0").set(1e9)
+    assert engine.evaluate()["verdict"] == "ok"
+
+
 def test_health_engine_broken_rule_degrades_not_raises():
     reg, engine = _snap_engine()
     # A rule that cannot read its signal contributes an engine_error
