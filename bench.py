@@ -748,6 +748,11 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
                 / max(rec["fleet_sampler"]["bytes_per_trained_seq"], 1e-9),
                 2,
             )
+        # Policy-driven recovery probe (ISSUE 16): the same 3-actor fleet
+        # with --autoscale 1 and a kill_actor drill — the health loop
+        # (not the backoff ladder) restores the population, and the leg
+        # records the closed loop's kill->spawn latency.
+        rec["fleet_autoscale"] = _autoscale_leg(phases)
         # Multi-chip learner probe (ISSUE 9): --learner-dp over a forced
         # 2-virtual-device CPU mesh (subprocess legs), dp=1 vs dp=2 at
         # equal fleet size, through the full train.py CLI wiring.
@@ -1176,6 +1181,123 @@ def _shard_procs_leg(phases: int = 12) -> dict:
     return leg
 
 
+def _autoscale_leg(phases: int = 12) -> dict:
+    """``python bench.py fleet_autoscale`` — the policy-driven recovery
+    probe (ISSUE 16): a 3-actor fleet through the real train.py CLI with
+    ``--autoscale 1`` and a ``kill_actor@p3`` drill.  Under autoscale the
+    supervisor runs restart="policy" — the crash leaves the slot down and
+    the HEALTH loop (actors_down finding -> hysteresis gate -> spawn)
+    restores the population, so ``time_to_restore_s`` is the closed
+    loop's latency (chaos_inject -> the landed autoscale_action, both
+    stamped ``t_mono`` in flight.jsonl), not the backoff ladder's.
+
+    The claims this leg records: run completion THROUGH the kill with
+    sheds=0 and steady_recompiles=0, ``autoscale_actions`` >= 1 (the
+    recovery was a decision, not a reflex — restarts stay 0 in policy
+    mode), and the recovery latency.  Rates stay contention artifacts on
+    this single-core container (the standing fleet-leg honesty note)."""
+    import json as _json
+    import tempfile
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("R2D2DPG_PALLAS_INTERPRET", "1")
+    logdir = tempfile.mkdtemp(prefix="bench_autoscale_")
+    cmd = [
+        sys.executable, "-m", "r2d2dpg_tpu.train",
+        "--config", "pendulum_r2d2", "--num-envs", "64",
+        "--actors", "3", "--fleet-publish-every", "4",
+        "--fleet-wire", "bf16", "--fleet-compress", "zlib",
+        "--chaos-spec", "kill_actor@p3",
+        "--autoscale", "1",
+        # Fast policy cadence so the recovery fits inside the short run:
+        # 2 consecutive findings at 0.5 s evals, 2 s between actions —
+        # the hysteresis MATH is pinned by tests/test_autoscaler.py; the
+        # leg measures the closed loop's end-to-end latency.
+        "--autoscale-fire", "2", "--autoscale-every", "0.5",
+        "--autoscale-cooldown", "2",
+        "--phases", str(phases), "--log-every", "0",
+        "--logdir", logdir,
+    ]
+    out_path = os.path.join(logdir, "bench_stdout.log")
+    err_path = os.path.join(logdir, "bench_stderr.log")
+    with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+        proc = subprocess.Popen(
+            cmd, env=env, cwd=HERE, stdout=out_f, stderr=err_f, text=True,
+            start_new_session=True,
+        )
+        try:
+            proc.wait(timeout=900)
+        except subprocess.TimeoutExpired:
+            _drain_group(proc)
+            return {"error": "autoscale leg exceeded 900s"}
+        finally:
+            if proc.poll() is None:
+                _drain_group(proc)
+            elif proc.returncode != 0:
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except OSError:
+                    pass
+    rc = proc.returncode
+    stdout = open(out_path).read()
+    stderr = open(err_path).read()
+    stats = _parse_fleet_stats(stdout)
+    if not stats:
+        return {"error": f"rc={rc}: {stderr[-300:]}"}
+    # Recovery latency off the flight timeline: the kill injection -> the
+    # LANDED autoscale action that restored the population (the paired
+    # origin="autoscale" actor_spawn rides the same tick).
+    t_kill = t_restore = None
+    try:
+        with open(os.path.join(logdir, "flight.jsonl")) as fh:
+            for line in fh:
+                try:
+                    e = _json.loads(line)
+                except ValueError:
+                    continue
+                if (
+                    e.get("kind") == "chaos_inject"
+                    and e.get("fault") == "kill_actor"
+                ):
+                    t_kill = e.get("t_mono")
+                if (
+                    e.get("kind") == "autoscale_action"
+                    and t_kill is not None
+                    and t_restore is None
+                    and e.get("t_mono", 0.0) >= t_kill
+                ):
+                    t_restore = e.get("t_mono")
+    except OSError:
+        pass
+    leg = {
+        # Central-drain topology: absorbed_seqs is this leg's volume
+        # column (trained_seqs is the sampler legs').
+        "absorbed_seqs": stats.get("absorbed_seqs", 0.0),
+        "sheds": stats.get("sheds", -1.0),
+        "autoscale_actions": stats.get("autoscale_actions", 0.0),
+        "autoscale_decisions": stats.get("autoscale_decisions", 0.0),
+        "autoscale_target": stats.get("autoscale_target", 0.0),
+        # Policy mode: the ladder never restarts — a nonzero value here
+        # means the crash-restart path fired alongside the policy loop,
+        # exactly the double-owner bug the mode exists to preclude.
+        "actor_restarts": stats.get("actor_restarts", -1.0),
+        "learner_steps_per_sec": round(
+            stats.get("train_learner_steps_per_sec", 0.0), 2
+        ),
+        "time_to_restore_s": (
+            round(t_restore - t_kill, 3)
+            if t_kill is not None and t_restore is not None
+            else None
+        ),
+        **_device_cols(stats),
+    }
+    if rc != 0:
+        leg["error"] = f"rc={rc}: {stderr[-300:]}"
+    return leg
+
+
 def worker() -> None:
     """Measurement body — runs in a child with the backend already pinned."""
     import jax
@@ -1306,5 +1428,10 @@ if __name__ == "__main__":
         # CPU-local, kill_shard drill included): ONE JSON object — merge
         # into BENCH_FLEET.json's "fleet_shard_procs" key.
         print(json.dumps({"fleet_shard_procs": _shard_procs_leg()}))
+    elif len(sys.argv) > 1 and sys.argv[1] == "fleet_autoscale":
+        # Just the policy-driven recovery leg (ISSUE 16; subprocess,
+        # CPU-local, kill_actor drill under --autoscale 1): ONE JSON
+        # object — merge into BENCH_FLEET.json's "fleet_autoscale" key.
+        print(json.dumps({"fleet_autoscale": _autoscale_leg()}))
     else:
         main()

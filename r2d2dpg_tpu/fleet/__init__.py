@@ -28,6 +28,10 @@ tests/test_fleet.py.
 - ``supervisor`` — spawn/monitor/restart-with-backoff for the actor
   (and shard, ``role="shard"``) subprocesses; crashes land in the
   flight recorder.
+- ``autoscaler`` — the health→actuation policy loop (``--autoscale 1``,
+  ISSUE 16): maps /health findings to hysteresis-gated
+  spawn/kill/replace actions through the supervisor's runtime resize
+  API.
 - ``chaos``      — seeded fault-injection drills at the fleet's real
   boundaries (SIGKILL / stall / byte flip / socket close), each asserting
   its documented recovery (ISSUE 7).
@@ -36,6 +40,11 @@ See docs/FLEET.md for the wire protocol, backpressure/shed contract,
 noise-ladder mapping, determinism anchor, and the failure-modes matrix.
 """
 
+from r2d2dpg_tpu.fleet.autoscaler import (
+    AutoscaleConfig,
+    Autoscaler,
+    ScaleAction,
+)
 from r2d2dpg_tpu.fleet.chaos import ChaosEngine, Fault, parse_chaos_spec
 from r2d2dpg_tpu.fleet.ingest import (
     FleetConfig,
@@ -63,6 +72,8 @@ from r2d2dpg_tpu.fleet.wire import WireConfig
 
 __all__ = [
     "ActorSupervisor",
+    "AutoscaleConfig",
+    "Autoscaler",
     "ChaosEngine",
     "Fault",
     "FleetConfig",
@@ -77,6 +88,7 @@ __all__ = [
     "WireConfig",
     "default_actor_argv",
     "load_fleet_counters",
+    "ScaleAction",
     "parse_chaos_spec",
     "save_fleet_counters",
     "shard_for_actor",

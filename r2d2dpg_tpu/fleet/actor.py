@@ -30,6 +30,7 @@ import argparse
 import dataclasses
 import socket as socket_mod
 import sys
+import threading
 import time
 from typing import Any, Optional
 
@@ -213,6 +214,13 @@ class FleetActor:
         self._sheds = 0
         self._phase = 0
         self._batches = 0  # emitted (post-warmup) batches: the chaos clock
+        # Orderly drain (ISSUE 16 scale-down): once set — SIGUSR1 from
+        # the supervisor's retire_slot, or request_drain() in-process —
+        # the session loop exits after the CURRENT phase's ack lands, so
+        # the banked accounting is folded, then falls through to BYE and
+        # a zero exit.  Scale-down loses no steps and looks nothing like
+        # a crash.
+        self._drain = threading.Event()
         self._last_env_steps = 0.0  # for per-phase deltas (see run)
         # At-least-once stats accounting: the per-phase episode/step
         # DELTAS ride the SEQS message and are cleared only once an ack
@@ -350,6 +358,15 @@ class FleetActor:
         return refs
 
     # ------------------------------------------------------------------ run
+    def request_drain(self) -> None:
+        """Ask the actor to leave the fleet cleanly: finish the current
+        phase (its ack folds the pending accounting), send BYE, return.
+        Signal-safe and idempotent — the supervisor's retire path routes
+        SIGUSR1 here, and the autoscaler's scale-down rides on it."""
+        if not self._drain.is_set():
+            self._drain.set()
+            flight_event("actor_drain", phase=self._phase)
+
     def run(self, max_phases: Optional[int] = None) -> None:
         """Stream until the server goes away (orderly end) or an
         unrecoverable error surfaces (crash — nonzero exit, the supervisor
@@ -454,7 +471,9 @@ class FleetActor:
                 flight_event("actor_reconnect", phase=self._phase)
                 self._obs_reconnects.inc()
             self._maybe_send_telem(sock, force=True)
-            while max_phases is None or self._phase < max_phases:
+            while (
+                max_phases is None or self._phase < max_phases
+            ) and not self._drain.is_set():
                 if self.chaos is not None:
                     # The stall drill: stop reading AND sending mid-loop,
                     # exactly what a wedged env or GC pause looks like on
@@ -797,6 +816,13 @@ def main(argv=None) -> None:
         # e.g. a malformed --chaos-spec: deterministic misconfiguration,
         # refused at startup rather than as a crash-looping fleet.
         raise SystemExit(f"fleet actor {args.actor_id}: {e}")
+    # The supervisor's retire_slot speaks SIGUSR1 (ISSUE 16 scale-down):
+    # finish the phase, fold the accounting via its ack, BYE, exit 0.
+    # PEP 475 restarts the interrupted socket call, so a drain never
+    # tears a frame — it lands at the next loop check.
+    import signal
+
+    signal.signal(signal.SIGUSR1, lambda *_: actor.request_drain())
     flight_event("actor_start", phase=0, address=args.connect)
     try:
         actor.run(max_phases=args.phases)

@@ -260,11 +260,17 @@ class IngestServer:
             # /health actors_down rule compares the supervisor's
             # r2d2dpg_fleet_actors_alive against this, so the verdict
             # needs no out-of-band config to know what "all actors up"
-            # means.
-            reg.gauge(
+            # means.  Kept as an attribute so autoscale resizes
+            # (set_expected_actors) move the SAME series the health rule
+            # reads — the verdict tracks the moving target, not the
+            # startup value.
+            self._obs_expected = reg.gauge(
                 "r2d2dpg_fleet_actors_expected",
                 "the fleet's actor spawn target (--actors N)",
-            ).set(float(expected_actors))
+            )
+            self._obs_expected.set(float(expected_actors))
+        else:
+            self._obs_expected = None
         self._obs_peer_dead = reg.counter(
             "r2d2dpg_fleet_peer_dead_total",
             "connections reaped after a silent heartbeat deadline (the "
@@ -417,6 +423,23 @@ class IngestServer:
         has executed): from here on, queue-full waits shed after
         ``shed_after_s`` instead of the startup grace."""
         self._steady.set()
+
+    @property
+    def is_steady(self) -> bool:
+        """Whether the warm-up grace has ended (mark_steady ran) — the
+        autoscaler's warm-up exemption gate: load-based scale decisions
+        are deferred until the loop is past its first compiled phase."""
+        return self._steady.is_set()
+
+    def set_expected_actors(self, n: int) -> None:
+        """Move the fleet's actor population target (ISSUE 16): a landed
+        autoscale resize updates ``r2d2dpg_fleet_actors_expected`` so the
+        /health ``actors_down`` rule — and every scrape — judges against
+        the CURRENT target, not the spawn-time ``--actors``.  A no-op
+        when the server was built without an expected count (embedders
+        that never declared a target don't grow one mid-run)."""
+        if self._obs_expected is not None:
+            self._obs_expected.set(float(n))
 
     # ---------------------------------------------------------------- params
     def publish_params(self, version: int, params: Any) -> None:

@@ -1636,6 +1636,15 @@ class ShardProcTier:
             return False
         return self.supervisor.kill_actor(proc_index)
 
+    def respawn_proc(self, proc_index: int) -> bool:
+        """The autoscaler's ``respawn_shard_proc`` actuator (ISSUE 16):
+        explicitly respawn one shard process — including a slot the
+        backoff ladder gave up on.  Pending-until-landed: returns False
+        while the slot is alive or the ladder still owns its respawn."""
+        if self.supervisor is None:
+            return False
+        return self.supervisor.spawn_slot(proc_index, origin="autoscale")
+
     @property
     def restarts_total(self) -> int:
         return 0 if self.supervisor is None else self.supervisor.restarts_total

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -38,6 +39,16 @@ class SupervisorConfig:
     healthy_after_s: float = 60.0  # uptime that resets the backoff ladder
     max_restarts: Optional[int] = None  # per actor; None = never give up
     poll_s: float = 0.2
+    # Who owns a crashed slot's respawn (ISSUE 16): "backoff" is the
+    # reflexive ladder above; "policy" records the crash and leaves the
+    # slot DOWN for an external policy engine (fleet/autoscaler.py) to
+    # replace via spawn_slot — the autoscaled fleet's recovery is a
+    # decision, not a reflex.  Terminal exits give the slot up either way.
+    restart: str = "backoff"
+    # retire_slot drain window: seconds a retiring worker gets to finish
+    # its phase and send BYE before the monitor escalates SIGTERM (and,
+    # one more window later, SIGKILL).
+    retire_grace_s: float = 10.0
 
 
 @dataclasses.dataclass
@@ -48,6 +59,14 @@ class _ActorSlot:
     consecutive_crashes: int = 0
     restart_at: Optional[float] = None  # backoff deadline when dead
     gave_up: bool = False
+    # Runtime-resize state (ISSUE 16): a retired slot is DRAINING out of
+    # the fleet (SIGUSR1 -> finish phase -> BYE -> exit 0) — the monitor
+    # must never read its exit as a crash to restart (that churn is the
+    # exact bug the retire path exists to avoid).  ``retire_at`` is the
+    # escalation deadline; ``term_sent`` marks SIGTERM already escalated.
+    retired: bool = False
+    retire_at: Optional[float] = None
+    term_sent: bool = False
 
 
 class ActorSupervisor:
@@ -106,6 +125,11 @@ class ActorSupervisor:
         self._slots: Dict[int, _ActorSlot] = {
             i: _ActorSlot() for i in range(num_actors)
         }
+        # The runtime population target (ISSUE 16): starts at the spawn
+        # count; set_target moves it while the fleet is live.  num_actors
+        # stays the STARTUP value — chaos fault hashing and the sigma
+        # ladder width are fixed at spawn time and must not drift with it.
+        self._target = num_actors
         self._lock = threading.Lock()
         self._stopping = threading.Event()
         self._monitor: Optional[threading.Thread] = None
@@ -192,6 +216,177 @@ class ActorSupervisor:
             return True
         return False
 
+    # ------------------------------------------------- runtime resize (16)
+    @property
+    def target(self) -> int:
+        """The current population target (set_target moves it)."""
+        with self._lock:
+            return self._target
+
+    def slot_states(self) -> Dict[int, str]:
+        """Each slot's lifecycle state, for policy decisions and tests:
+        ``live`` / ``backoff`` (ladder owns a pending respawn) / ``down``
+        (dead, nobody owns a respawn — a policy-mode corpse) /
+        ``retired`` / ``gave_up``."""
+        out: Dict[int, str] = {}
+        with self._lock:
+            for i, s in self._slots.items():
+                if s.gave_up:
+                    out[i] = "gave_up"
+                elif s.retired:
+                    out[i] = "retired"
+                elif s.proc is not None and s.proc.poll() is None:
+                    out[i] = "live"
+                elif s.restart_at is not None:
+                    out[i] = "backoff"
+                else:
+                    out[i] = "down"
+        return out
+
+    def spawn_slot(self, actor_id: int, *, origin: str = "resize") -> bool:
+        """Explicitly (re)spawn one slot at runtime — the policy engine's
+        replace/scale-up actuator.
+
+        Pending-until-landed contract (the PR 12 chaos convention): the
+        spawn returns False — caller keeps it pending and retries — when
+        the slot's process is still alive, or when the backoff ladder
+        already owns a pending respawn (``restart_at`` armed): landing it
+        anyway would put TWO processes in one ladder lane.  A gave-up
+        terminal slot IS spawnable here — this explicit call is the
+        "unless explicitly re-targeted" escape hatch scale-up never takes.
+        """
+        with self._lock:
+            if self._stopping.is_set():
+                return False
+            slot = self._slots.get(actor_id)
+            if slot is None:
+                slot = self._slots[actor_id] = _ActorSlot()
+            if slot.proc is not None and slot.proc.poll() is None:
+                return False  # still alive (or still draining a retire)
+            if slot.restart_at is not None and not slot.gave_up:
+                return False  # mid-backoff: the monitor owns this respawn
+            resurrected = slot.gave_up
+            slot.gave_up = False
+            slot.retired = False
+            slot.retire_at = None
+            slot.term_sent = False
+            slot.consecutive_crashes = 0
+            try:
+                self._spawn(actor_id)
+            except Exception as e:  # noqa: BLE001 — same contract as the
+                # monitor's respawn: a failed exec is an event, never an
+                # exception into the policy loop.
+                flight_event(
+                    f"{self.role}_spawn_failed",
+                    **{self.id_field: actor_id},
+                    error=f"{type(e).__name__}: {e}",
+                )
+                return False
+            flight_event(
+                f"{self.role}_spawn",
+                **{self.id_field: actor_id},
+                origin=origin,
+                resurrected=resurrected,
+            )
+            return True
+
+    def retire_slot(self, actor_id: int, *, origin: str = "resize") -> bool:
+        """Drain one slot out of the fleet — the scale-down actuator.
+
+        The slot is marked retired FIRST (the monitor skips it, so its
+        exit can never read as a crash to restart), then the worker gets
+        SIGUSR1: fleet/actor.py finishes its current phase, sends BYE
+        (banked accounting already folded by the last ack) and exits 0.
+        A worker that ignores the drain past ``retire_grace_s`` is
+        escalated SIGTERM, then SIGKILL one grace later (_poll_once).
+        Returns False for a slot that is already retired/gave-up/absent
+        (no-op; pending-until-landed callers may retry elsewhere)."""
+        with self._lock:
+            slot = self._slots.get(actor_id)
+            if slot is None or slot.retired or slot.gave_up:
+                return False
+            slot.retired = True
+            slot.restart_at = None
+            slot.retire_at = self._clock() + self.config.retire_grace_s
+            slot.term_sent = False
+            proc = slot.proc
+            draining = proc is not None and proc.poll() is None
+            if draining:
+                try:
+                    proc.send_signal(signal.SIGUSR1)
+                except (OSError, ValueError):
+                    draining = False
+            flight_event(
+                f"{self.role}_retire",
+                **{self.id_field: actor_id},
+                origin=origin,
+                draining=draining,
+            )
+            return True
+
+    def set_target(self, n: int, *, lane_limit: Optional[int] = None) -> Dict[str, List[int]]:
+        """Resize the live population to ``n`` slots.
+
+        Scale-down retires the HIGHEST-indexed active slots (the newest
+        sigma-ladder lanes drain first; lane 0 is the greediest explorer
+        and the last to go).  Scale-up re-fills the LOWEST free lane —
+        where "free" never includes a gave-up terminal slot (resurrection
+        needs an explicit spawn_slot) or a lane whose old process is
+        still draining.  ``lane_limit`` caps mintable lane ids (the
+        autoscaler passes its --autoscale-max so a new actor always fits
+        the global sigma ladder).  Returns the slot ids spawned and
+        retiring; a spawn that cannot land (mid-backoff lane) stops the
+        walk — callers retry on their own cadence."""
+        if n < 0:
+            raise ValueError("set_target: n must be >= 0")
+        with self._lock:
+            previous, self._target = self._target, n
+        if n != previous:
+            flight_event(
+                f"{self.role}_set_target", target=n, previous=previous
+            )
+        spawned: List[int] = []
+        retiring: List[int] = []
+        while True:
+            with self._lock:
+                active = sorted(
+                    i
+                    for i, s in self._slots.items()
+                    if not s.retired and not s.gave_up
+                )
+            if len(active) <= n:
+                break
+            if not self.retire_slot(active[-1], origin="resize"):
+                break
+            retiring.append(active[-1])
+        while True:
+            with self._lock:
+                active = {
+                    i
+                    for i, s in self._slots.items()
+                    if not s.retired and not s.gave_up
+                }
+                if len(active) >= n:
+                    break
+                lane = 0
+                while True:
+                    s = self._slots.get(lane)
+                    if lane not in active and (
+                        s is None
+                        or (
+                            not s.gave_up
+                            and (s.proc is None or s.proc.poll() is not None)
+                        )
+                    ):
+                        break
+                    lane += 1
+                if lane_limit is not None and lane >= lane_limit:
+                    lane = None
+            if lane is None or not self.spawn_slot(lane, origin="resize"):
+                break
+            spawned.append(lane)
+        return {"spawned": spawned, "retiring": retiring}
+
     # -------------------------------------------------------------- internal
     def _spawn(self, actor_id: int) -> None:
         slot = self._slots[actor_id]
@@ -226,6 +421,34 @@ class ActorSupervisor:
         with self._lock:
             for actor_id, slot in self._slots.items():
                 if slot.gave_up:
+                    continue
+                if slot.retired:
+                    # Draining out (retire_slot): the exit here is ASKED
+                    # FOR — reap it as a drain, never as a crash, and
+                    # never arm the backoff ladder (an autoscale kill
+                    # must not trigger crash-restart churn).
+                    proc = slot.proc
+                    if proc is None:
+                        continue  # already reaped
+                    if proc.poll() is not None:
+                        flight_event(
+                            f"{self.role}_drained",
+                            **{self.id_field: actor_id},
+                            returncode=proc.returncode,
+                        )
+                        slot.proc = None
+                        slot.retire_at = None
+                        continue
+                    if slot.retire_at is not None and now >= slot.retire_at:
+                        # Ignored the SIGUSR1 drain: escalate SIGTERM,
+                        # then SIGKILL one more grace window later.
+                        if not slot.term_sent:
+                            proc.terminate()
+                            slot.term_sent = True
+                            slot.retire_at = now + cfg.retire_grace_s
+                        else:
+                            proc.kill()
+                            slot.retire_at = None  # next poll reaps
                     continue
                 if slot.proc is not None and slot.proc.poll() is None:
                     # Healthy uptime resets the backoff ladder.
@@ -275,6 +498,13 @@ class ActorSupervisor:
                             **{self.id_field: actor_id},
                             restarts=slot.restarts,
                         )
+                        continue
+                    if cfg.restart == "policy":
+                        # Policy-owned recovery (ISSUE 16): leave the
+                        # slot DOWN — no restart_at, no reflexive
+                        # respawn.  The autoscaler reads actors_down and
+                        # decides; its spawn_slot is the only way back.
+                        slot.proc = None
                         continue
                     slot.restart_at = now + backoff
                 if (

@@ -189,6 +189,17 @@ def _fleet_only_knobs(a) -> bool:
     )
 
 
+def _autoscale_only_knobs(a) -> bool:
+    return (
+        bool(getattr(a, "autoscale_dry_run", 0))
+        or getattr(a, "autoscale_min", 1) != 1
+        or getattr(a, "autoscale_max", 0) != 0
+        or getattr(a, "autoscale_cooldown", 30.0) != 30.0
+        or getattr(a, "autoscale_every", 2.0) != 2.0
+        or getattr(a, "autoscale_fire", 3) != 3
+    )
+
+
 def _chaos_sampler_faults(a) -> bool:
     if not a.chaos_spec or a.replay_shards:
         return False
@@ -316,6 +327,34 @@ REFUSALS: Tuple[Refusal, ...] = (
         ),
         match="require --actors",
         argv=("--fleet-wire", "bf16"),
+    ),
+    # -------------------------------------------------------- autoscaler
+    Refusal(
+        key="autoscale-without-actors",
+        when=lambda a, np: bool(
+            getattr(a, "autoscale", 0) and not a.actors
+        ),
+        reason=(
+            "--autoscale 1 requires --actors N: the policy loop actuates "
+            "the fleet supervisor's population, which the in-process "
+            "schedules do not spawn (docs/TOPOLOGY.md)"
+        ),
+        match="requires --actors",
+        argv=("--autoscale", "1"),
+    ),
+    Refusal(
+        key="autoscale-knobs-without-autoscale",
+        when=lambda a, np: bool(
+            not getattr(a, "autoscale", 0) and _autoscale_only_knobs(a)
+        ),
+        reason=(
+            "--autoscale-dry-run/--autoscale-min/--autoscale-max/"
+            "--autoscale-cooldown/--autoscale-every/--autoscale-fire "
+            "require --autoscale 1 (without the policy loop they would "
+            "silently configure nothing; docs/TOPOLOGY.md)"
+        ),
+        match="require --autoscale",
+        argv=("--actors", "2", "--autoscale-dry-run", "1"),
     ),
     # ------------------------------------------------------ replay shards
     Refusal(
@@ -500,6 +539,33 @@ def validate(args, process_count: int = 1) -> Topology:
         )
     if args.learner_dp and args.learner_dp < 1:
         raise SystemExit("--learner-dp must be >= 1 (0 = off)")
+    if getattr(args, "autoscale", 0):
+        if getattr(args, "autoscale_cooldown", 30.0) <= 0:
+            raise SystemExit("--autoscale-cooldown must be > 0 seconds")
+        if getattr(args, "autoscale_every", 2.0) <= 0:
+            raise SystemExit("--autoscale-every must be > 0 seconds")
+        if getattr(args, "autoscale_fire", 3) < 1:
+            raise SystemExit("--autoscale-fire must be >= 1")
+        # Bounds are judged against --actors; without it the pairing row
+        # (autoscale-without-actors) below is the authority.
+        if args.actors:
+            amin = int(getattr(args, "autoscale_min", 1))
+            amax = (
+                int(getattr(args, "autoscale_max", 0)) or int(args.actors)
+            )
+            if amin < 1:
+                raise SystemExit("--autoscale-min must be >= 1")
+            if amax < amin:
+                raise SystemExit(
+                    f"--autoscale-max ({amax}) must be >= --autoscale-min "
+                    f"({amin})"
+                )
+            if args.actors > amax:
+                raise SystemExit(
+                    f"--autoscale-max ({amax}) must be >= --actors "
+                    f"({args.actors}): the startup population must fit "
+                    f"the sigma-ladder bound the autoscaler enforces"
+                )
     if args.fleet_heartbeat is not None and args.fleet_heartbeat <= 0:
         raise SystemExit("--fleet-heartbeat must be > 0 seconds")
     if not 0.0 <= args.trace_sample <= 1.0:

@@ -181,6 +181,49 @@ def parse_args(argv=None) -> argparse.Namespace:
         "injection lands in flight.jsonl + "
         "r2d2dpg_fleet_chaos_drills_total"
     )
+    # Autoscaler (docs/FLEET.md "Autoscaling", ISSUE 16): the
+    # health→actuation policy loop over the fleet supervisor.
+    p.add_argument(
+        "--autoscale", type=int, default=0, choices=[0, 1],
+        help="close the health→actuation loop (fleet/autoscaler.py): a "
+        "policy thread evaluates the in-process health engine and maps "
+        "findings to hysteresis-gated spawn/kill/replace actions through "
+        "the supervisor's runtime resize API; crashed actors are "
+        "replaced by POLICY (SupervisorConfig restart='policy') instead "
+        "of the reflexive backoff ladder.  0 = off (structurally inert; "
+        "default)"
+    )
+    p.add_argument(
+        "--autoscale-dry-run", type=int, default=0, choices=[0, 1],
+        help="walk the full decision path — streaks, cooldown, window "
+        "budget — logging autoscale_decision events, but never actuate "
+        "(the supervisor keeps its reflexive ladder)"
+    )
+    p.add_argument(
+        "--autoscale-min", type=int, default=1, metavar="N",
+        help="scale-down floor on the actor population (default 1)"
+    )
+    p.add_argument(
+        "--autoscale-max", type=int, default=0, metavar="N",
+        help="scale-up ceiling on the actor population; also the GLOBAL "
+        "sigma-ladder width (actors spawn with --num-actors max so every "
+        "mintable lane has its own exploration sigma).  0 = pinned to "
+        "--actors (no scale-up; default)"
+    )
+    p.add_argument(
+        "--autoscale-cooldown", type=float, default=30.0, metavar="S",
+        help="minimum seconds between landed autoscale actions (default "
+        "30)"
+    )
+    p.add_argument(
+        "--autoscale-every", type=float, default=2.0, metavar="S",
+        help="health-evaluation cadence of the policy loop (default 2)"
+    )
+    p.add_argument(
+        "--autoscale-fire", type=int, default=3, metavar="K",
+        help="consecutive evaluations a health rule must fire before it "
+        "may act (hysteresis; default 3)"
+    )
     # Agent/exploration hyperparameter overrides (VERDICT r2 weak #3: probe
     # whether the walker plateau is data-bound or hparam-capped).
     p.add_argument("--sigma-max", type=float, default=None,
@@ -953,6 +996,7 @@ def _run_fleet(
     from r2d2dpg_tpu.fleet import (
         ActorSupervisor,
         FleetConfig,
+        SupervisorConfig,
         WireConfig,
         default_actor_argv,
     )
@@ -1162,12 +1206,21 @@ def _run_fleet(
         spawn_env = dict(os.environ)
         spawn_env["R2D2DPG_FLEET_TOKEN"] = fleet_token
 
+    # The GLOBAL sigma-ladder width (ISSUE 16): every lane the autoscaler
+    # may ever mint needs its own exploration sigma, so actors spawn with
+    # --num-actors max(--actors, --autoscale-max) and slice that ladder.
+    # Chaos fault hashing rides the same value on BOTH wire ends (the
+    # learner's engine and each actor's ActorChaos must agree on every
+    # fault's target).  With --autoscale 0 this is exactly --actors — the
+    # structural-inertness anchor.
+    ladder_n = max(args.actors, args.autoscale_max if args.autoscale else 0)
+
     def argv_fn(i: int):
         argv = default_actor_argv(
             i,
             config_name=args.config,
             address=address,
-            num_actors=args.actors,
+            num_actors=ladder_n,
             seed=cfg.trainer.seed,
             extra=extra,
         )
@@ -1178,9 +1231,16 @@ def _run_fleet(
             ]
         return argv
 
+    sup_config = SupervisorConfig()
+    if args.autoscale and not args.autoscale_dry_run:
+        # Crash recovery becomes a DECISION: the ladder records the crash
+        # and leaves the slot down for the policy loop's spawn_slot (a
+        # dry run keeps the reflexive ladder — observe, don't own).
+        sup_config = dataclasses.replace(sup_config, restart="policy")
     supervisor = ActorSupervisor(
         argv_fn,
         args.actors,
+        config=sup_config,
         env=spawn_env,
         log_path_fn=(
             (lambda i: os.path.join(args.logdir, f"actor{i}.log"))
@@ -1193,10 +1253,39 @@ def _run_fleet(
         engine = fleet_chaos.ChaosEngine(
             chaos_faults,
             seed=cfg.trainer.seed,
-            num_actors=args.actors,
+            num_actors=ladder_n,
             supervisor=supervisor,
             server=learner.server,
             shard_tier=shard_tier,
+        )
+    autoscaler = None
+    if args.autoscale:
+        from r2d2dpg_tpu.fleet.autoscaler import AutoscaleConfig, Autoscaler
+
+        # Reuse the exporter's armed engine when --obs-port is up (the
+        # health plane was built re-entrant for exactly this: the policy
+        # loop racing an operator's curl); arm a private one otherwise.
+        health = getattr(obs.current_exporter(), "health", None)
+        if health is None:
+            health = obs.HealthEngine(
+                _health_config(args),
+                registry=obs.get_registry(),
+                mirror=obs.get_remote_mirror(),
+            )
+        autoscaler = Autoscaler(
+            health,
+            supervisor,
+            shard_tier=shard_tier,
+            config=AutoscaleConfig(
+                min_actors=args.autoscale_min,
+                max_actors=args.autoscale_max or args.actors,
+                cooldown_s=args.autoscale_cooldown,
+                eval_every_s=args.autoscale_every,
+                fire_threshold=args.autoscale_fire,
+                dry_run=bool(args.autoscale_dry_run),
+            ),
+            ready_fn=lambda: learner.server.is_steady,
+            expected_fn=learner.server.set_expected_actors,
         )
 
     if args.phases is not None:
@@ -1219,6 +1308,8 @@ def _run_fleet(
         if shard_tier is not None:
             shard_tier.start()
         supervisor.start()
+        if autoscaler is not None:
+            autoscaler.start()
         state = learner.run(
             num_phases,
             state=state,
@@ -1231,10 +1322,23 @@ def _run_fleet(
             phase_fn=engine.on_phase if engine is not None else None,
             **run_kwargs,
         )
-        _fold_executor_stats("fleet", learner.stats(), final)
-        final["fleet_actor_restarts"] = float(supervisor.restarts_total)
+        # Supervisor/policy/tier counters join the learner's stats BEFORE
+        # the fold so they ride the printed ``fleet:`` line too — the
+        # subprocess bench legs parse that line, not the metrics dict.
+        fstats = dict(learner.stats())
+        fstats["actor_restarts"] = float(supervisor.restarts_total)
+        if autoscaler is not None:
+            a_stats = autoscaler.stats()
+            fstats["autoscale_actions"] = float(
+                sum(a_stats["autoscale_actions"].values())
+            )
+            fstats["autoscale_decisions"] = float(
+                a_stats["autoscale_decisions"]
+            )
+            fstats["autoscale_target"] = float(a_stats["autoscale_target"])
         if shard_tier is not None:
-            final["fleet_shard_restarts"] = float(shard_tier.restarts_total)
+            fstats["shard_restarts"] = float(shard_tier.restarts_total)
+        _fold_executor_stats("fleet", fstats, final)
         if engine is not None and engine.unfired():
             # A drill that never got its phase must not read as one that
             # passed: name it loudly in the log and the flight ring.
@@ -1301,6 +1405,10 @@ def _run_fleet(
                 # teardown (orphaning their process groups) and mask the
                 # run's own error.  Loud note, never a raise.
                 print(f"obs: final evidence stamp failed: {e!r}", flush=True)
+        # Autoscaler FIRST of all: a policy tick racing the teardown
+        # would read stopped supervisors as a fleet to repopulate.
+        if autoscaler is not None:
+            autoscaler.stop()
         # Supervisor FIRST (its stopping flag makes the actors' connection
         # loss an orderly exit, not a crash to restart), then the SHARD
         # TIER (its stop flag releases any ingest handler parked in the
@@ -1329,7 +1437,7 @@ def _run_fleet(
             chaos_faults,
             args.logdir,
             seed=cfg.trainer.seed,
-            num_actors=args.actors,
+            num_actors=ladder_n,
         )
         if args.shard_procs:
             # Shard-process-boundary drills (stall_shard) fire in the

@@ -160,6 +160,10 @@ run_evidence() {
         echo "$dir: device-plane gate FAILED (attempt $attempt)"
         continue
       fi
+      if ! autoscale_gate "$dir" "$@"; then
+        echo "$dir: autoscale recovery gate FAILED (attempt $attempt)"
+        continue
+      fi
       timeout --kill-after=30 --signal=TERM 1800 \
         env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
         python -m r2d2dpg_tpu.eval $evalflags \
@@ -587,6 +591,106 @@ device_gate() {
     return 1
   fi
   return 0
+}
+
+# Autoscale evidence gate (ISSUE 16): a run dir trained with
+# --autoscale 1 may only be blessed (.done) if (a) the non-slow
+# kill-drill recovery test passes on this checkout — proof the policy
+# loop (not the backoff ladder) restores a killed actor, with zero
+# crash-restarts and zero sheds (tests/test_autoscaler.py) — and (b)
+# every autoscale_action event in the dir's flight dumps pairs with a
+# LANDED origin="autoscale" spawn/retire actuation: an action the
+# supervisor never executed is a policy engine claiming recoveries it
+# didn't perform, and no rate measured under it can be blessed.  The
+# resolved autoscale knobs are stamped into the evidence dir
+# (autoscale.txt), so a blessed number always says which policy bounds
+# governed its population.  --autoscale 0 runs pass through untouched
+# (the mode is structurally inert there — topology determinism anchors
+# cover it).  Metric names (r2d2dpg_autoscale_*) conform to the
+# lint_obs.sh scheme check; no allowlist entry needed.
+#   autoscale_gate <dir> <train args...>
+autoscale_gate() {
+  local dir=$1
+  shift
+  local _as="" _as_min="" _as_max="" _as_prev=""
+  local _as_arg
+  for _as_arg in "$@"; do
+    # Both argparse spellings: "--flag value" and "--flag=value".
+    case "$_as_arg" in
+      --autoscale=*) _as=${_as_arg#*=} ;;
+      --autoscale-min=*) _as_min=${_as_arg#*=} ;;
+      --autoscale-max=*) _as_max=${_as_arg#*=} ;;
+    esac
+    case "$_as_prev" in
+      --autoscale) _as=$_as_arg ;;
+      --autoscale-min) _as_min=$_as_arg ;;
+      --autoscale-max) _as_max=$_as_arg ;;
+    esac
+    _as_prev=$_as_arg
+  done
+  if [ -z "$_as" ] || [ "$_as" = 0 ]; then
+    return 0  # autoscale off: structurally inert, nothing to gate
+  fi
+  printf 'autoscale=%s min=%s max=%s\n' \
+    "$_as" "${_as_min:-1}" "${_as_max:-actors}" > "$dir/autoscale.txt"
+  # (b) action/actuation pairing over the run's own flight dumps — a
+  # cheap scan, re-checked on every pass (no stamp to go stale).
+  if ! python - "$dir"/flight*.jsonl <<'PYEOF'
+import json
+import sys
+
+bad = False
+for path in sys.argv[1:]:
+    try:
+        lines = open(path).read().splitlines()
+    except OSError:
+        continue
+    actions = 0
+    landed = 0
+    for line in lines:
+        try:
+            e = json.loads(line)
+        except ValueError:
+            continue
+        kind = e.get("kind", "")
+        if kind == "autoscale_action":
+            actions += 1
+        elif (
+            kind in ("actor_spawn", "actor_retire",
+                     "shard_spawn", "shard_retire")
+            and e.get("origin") == "autoscale"
+        ):
+            landed += 1
+    if actions > landed:
+        print(
+            f"{path}: {actions} autoscale_action event(s) but only "
+            f"{landed} landed origin=autoscale spawn/retire event(s) — "
+            "the policy loop claimed an actuation the supervisor never "
+            "executed"
+        )
+        bad = True
+sys.exit(1 if bad else 0)
+PYEOF
+  then
+    echo "$dir: autoscale_gate: flight dumps fail the action/actuation" \
+         "pairing check (see lines above)"
+    return 1
+  fi
+  # (a) the kill-drill recovery anchor, stamped per dir like the other
+  # pytest-backed gates.
+  if [ -f "$dir/.autoscale_recovery_ok" ]; then
+    return 0
+  fi
+  if timeout --kill-after=30 900 \
+       env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+       XLA_FLAGS= \
+       python -m pytest tests/test_autoscaler.py -q -p no:cacheprovider \
+         -m 'not slow' -k kill_drill \
+       > "$dir/autoscale_gate.log" 2>&1; then
+    touch "$dir/.autoscale_recovery_ok"
+    return 0
+  fi
+  return 1
 }
 
 gate_on_box() {

@@ -1,0 +1,773 @@
+"""Autoscaler subsystem (ISSUE 16): supervisor runtime resize, the
+hysteresis policy engine, and the kill-drill recovery acceptance.
+
+Three layers, mirroring the subsystem's own:
+
+- **Supervisor resize API** — ``set_target``/``spawn_slot``/
+  ``retire_slot`` against the backoff/give-up ladder, all fake-clock
+  (``_poll_once`` driven directly, no sleeps): an autoscale retire never
+  triggers crash-restart churn, a mid-backoff slot no-ops the explicit
+  spawn (pending-until-landed — the no-double-spawn pin), a gave-up
+  terminal slot is resurrected only by an explicit ``spawn_slot``.
+- **Hysteresis math** — per-rule fire streaks, the cooldown ring, the
+  actions-per-window budget, warm-up exemptions and dry-run, under an
+  injectable clock with a scriptable fake health engine.  Includes the
+  acceptance flapping fixture: alternating starving/ok findings produce
+  at most one action per cooldown window.
+- **Kill-drill e2e** (non-slow; ``scripts/lib_gate.sh autoscale_gate``
+  runs it) — a live 2-actor fleet under ``kill_actor@p3`` with the
+  supervisor in ``restart="policy"`` mode: the autoscaler (not the
+  reflexive ladder) restores the population, evidenced by an
+  ``autoscale_action`` paired with an ``origin="autoscale"`` spawn and
+  ``restarts_total == 0``.
+"""
+
+import sys
+import threading
+import time
+
+import pytest
+
+from r2d2dpg_tpu.configs import PENDULUM_TINY
+from r2d2dpg_tpu.fleet import (
+    ActorSupervisor,
+    AutoscaleConfig,
+    Autoscaler,
+    ChaosEngine,
+    FleetConfig,
+    FleetLearner,
+    SupervisorConfig,
+    parse_chaos_spec,
+)
+from r2d2dpg_tpu.obs import get_flight_recorder
+
+pytestmark = pytest.mark.autoscale
+
+
+# ---------------------------------------------------- fake-clock scaffolding
+class _FakeProc:
+    """poll()-able stand-in (the test_fleet.py pattern) plus the retire
+    path's signal surface: SIGUSR1/terminate/kill are recorded, and the
+    test flips ``returncode`` to simulate the worker exiting."""
+
+    def __init__(self, returncode=None):
+        self.returncode = returncode
+        self.signals = []
+        self.terminated = False
+        self.killed = False
+
+    def poll(self):
+        return self.returncode
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+
+    def terminate(self):
+        self.terminated = True
+
+    def kill(self):
+        self.killed = True
+        self.returncode = -9
+
+
+def _fake_clock_supervisor(num_actors=1, **cfg):
+    sup = ActorSupervisor(
+        lambda i: ["unused"],
+        num_actors,
+        config=SupervisorConfig(**cfg),
+        clock=lambda: 0.0,
+    )
+    spawned = []
+
+    def fake_spawn(actor_id):
+        slot = sup._slots[actor_id]
+        slot.proc = _FakeProc()
+        slot.restart_at = None
+        spawned.append(actor_id)
+
+    sup._spawn = fake_spawn
+    for i in range(num_actors):
+        sup._spawn(i)  # slots start live, no monitor thread
+    spawned.clear()
+    return sup, spawned
+
+
+# ------------------------------------------------- supervisor resize: retire
+def test_retire_slot_drains_without_crash_restart_churn():
+    """The satellite pin: an autoscale retire must never walk the
+    crash-restart ladder.  The retired worker's exit is reaped as
+    ``actor_drained`` — no crash event, no backoff, no restart."""
+    sup, spawned = _fake_clock_supervisor(backoff_base_s=0.5)
+    n0 = len(get_flight_recorder().events())
+    slot = sup._slots[0]
+    proc = slot.proc
+    assert sup.retire_slot(0, origin="autoscale")
+    assert proc.signals  # SIGUSR1 delivered: the drain request
+    assert slot.restart_at is None
+    proc.returncode = 0  # worker finished its phase, sent BYE, exited
+    sup._poll_once(1.0)
+    assert slot.proc is None and not spawned
+    sup._poll_once(100.0)  # and STAYS drained — no late respawn either
+    assert not spawned and sup.restarts_total == 0
+    events = get_flight_recorder().events()[n0:]
+    kinds = [e["kind"] for e in events]
+    assert "actor_retire" in kinds and "actor_drained" in kinds
+    assert "actor_crash" not in kinds and "actor_restart" not in kinds
+    retire = next(e for e in events if e["kind"] == "actor_retire")
+    assert retire["origin"] == "autoscale" and retire["draining"]
+
+
+def test_retire_slot_escalates_term_then_kill_on_deadline():
+    sup, _ = _fake_clock_supervisor(retire_grace_s=10.0)
+    slot = sup._slots[0]
+    proc = slot.proc
+    assert sup.retire_slot(0)
+    assert slot.retire_at == 10.0
+    sup._poll_once(9.9)  # inside the drain grace: nothing escalates
+    assert not proc.terminated
+    sup._poll_once(10.0)  # grace over: SIGTERM
+    assert proc.terminated and not proc.killed
+    sup._poll_once(19.9)  # second grace running
+    assert not proc.killed
+    sup._poll_once(20.0)  # ignored SIGTERM too: SIGKILL
+    assert proc.killed
+    sup._poll_once(20.1)  # the corpse reaps as a drain, not a crash
+    assert slot.proc is None and sup.restarts_total == 0
+
+
+def test_retire_slot_noops_on_retired_gave_up_or_absent():
+    sup, _ = _fake_clock_supervisor()
+    assert sup.retire_slot(0)
+    assert not sup.retire_slot(0)  # already draining
+    assert not sup.retire_slot(7)  # absent
+    sup._slots[0].retired = False
+    sup._slots[0].gave_up = True
+    assert not sup.retire_slot(0)  # terminal slots are not retire targets
+
+
+# -------------------------------------------------- supervisor resize: spawn
+def test_spawn_slot_noops_mid_backoff_pending_until_landed():
+    """The no-double-spawn fix, pinned: while the backoff ladder owns a
+    crashed slot's respawn, an explicit ``spawn_slot`` must no-op (False
+    — the caller keeps it pending and retries) instead of putting two
+    processes in one ladder lane."""
+    sup, spawned = _fake_clock_supervisor(backoff_base_s=0.5)
+    slot = sup._slots[0]
+    assert not sup.spawn_slot(0)  # live slot: no-op
+    slot.proc.returncode = 1
+    sup._poll_once(100.0)  # corpse found: ladder arms restart_at=100.5
+    assert slot.restart_at == 100.5
+    assert not sup.spawn_slot(0)  # mid-backoff: the ladder owns this lane
+    assert not spawned
+    sup._poll_once(100.5)  # the ladder's own respawn lands
+    assert spawned == [0] and sup.restarts_total == 1
+    assert not sup.spawn_slot(0)  # and the new incarnation is live: no-op
+    assert spawned == [0]
+
+
+def test_spawn_slot_lands_on_policy_mode_corpse():
+    """restart="policy": the ladder records the crash and leaves the slot
+    DOWN — no restart_at, no reflexive respawn ever — and the policy
+    engine's ``spawn_slot`` is what brings it back (restarts_total stays
+    0: replacement is a decision, not a crash-restart)."""
+    sup, spawned = _fake_clock_supervisor(restart="policy")
+    n0 = len(get_flight_recorder().events())
+    slot = sup._slots[0]
+    slot.proc.returncode = 1
+    sup._poll_once(100.0)
+    assert slot.proc is None and slot.restart_at is None
+    assert sup.slot_states()[0] == "down"
+    sup._poll_once(200.0)  # and stays down: policy owns the recovery
+    assert not spawned
+    assert sup.spawn_slot(0, origin="autoscale")
+    assert spawned == [0] and sup.restarts_total == 0
+    events = get_flight_recorder().events()[n0:]
+    assert any(e["kind"] == "actor_crash" for e in events)
+    spawn = next(e for e in events if e["kind"] == "actor_spawn")
+    assert spawn["origin"] == "autoscale" and not spawn["resurrected"]
+
+
+def test_policy_mode_terminal_exit_still_gives_up():
+    from r2d2dpg_tpu.utils.codes import TERMINAL_ACTOR_EXITS
+
+    sup, spawned = _fake_clock_supervisor(restart="policy")
+    slot = sup._slots[0]
+    slot.proc.returncode = next(iter(TERMINAL_ACTOR_EXITS))
+    sup._poll_once(100.0)
+    assert slot.gave_up and sup.slot_states()[0] == "gave_up"
+
+
+def test_spawn_slot_resurrects_gave_up_only_explicitly():
+    """A gave-up terminal slot must not be resurrected by scale-up
+    (set_target skips it) — only an explicit spawn_slot re-targets it."""
+    sup, spawned = _fake_clock_supervisor(num_actors=2)
+    n0 = len(get_flight_recorder().events())
+    sup._slots[0].gave_up = True
+    sup._slots[0].proc = None
+    # Scale-up walks PAST the gave-up lane: with lane 1 live and minting
+    # capped at 2 lanes, there is nowhere to grow — no spawn, and
+    # critically no resurrection.
+    res = sup.set_target(2, lane_limit=2)
+    assert res["spawned"] == [] and sup._slots[0].gave_up
+    assert not spawned
+    # Uncapped, it mints the NEXT lane rather than touch the terminal one.
+    res = sup.set_target(2)
+    assert res["spawned"] == [2] and sup._slots[0].gave_up
+    # The explicit escape hatch: spawn_slot resurrects.
+    assert sup.spawn_slot(0, origin="autoscale")
+    assert not sup._slots[0].gave_up
+    spawn = [
+        e
+        for e in get_flight_recorder().events()[n0:]
+        if e["kind"] == "actor_spawn" and e.get("actor") == 0
+    ]
+    assert spawn and spawn[-1]["resurrected"]
+
+
+def test_set_target_retires_highest_spawns_lowest_free():
+    sup, spawned = _fake_clock_supervisor(num_actors=3)
+    assert sup.target == 3
+    res = sup.set_target(2)
+    assert res["retiring"] == [2] and sup.target == 2
+    assert sup.slot_states()[2] == "retired"
+    # Scale back up while lane 2 is still draining: the walk must not
+    # reuse the draining lane (two processes, one sigma slice) — it
+    # mints lane 3 instead.
+    res = sup.set_target(3)
+    assert res["spawned"] == [3] and spawned == [3]
+    # Once lane 2's worker exits and reaps, it becomes free again.
+    sup._slots[2].proc.returncode = 0
+    sup._poll_once(50.0)
+    res = sup.set_target(4)
+    assert res["spawned"] == [2]
+
+
+# ------------------------------------------------------- policy-engine fakes
+def _finding(rule, detail="", value=1.0, threshold=0.0):
+    return {
+        "rule": rule,
+        "severity": "degraded",
+        "detail": detail,
+        "value": value,
+        "threshold": threshold,
+    }
+
+
+class _FakeEngine:
+    """Scriptable HealthEngine: each evaluate() pops the next findings
+    list (the last entry repeats once the script is exhausted)."""
+
+    def __init__(self, *script):
+        self.script = list(script)
+        self.evaluations = 0
+
+    def evaluate(self):
+        self.evaluations += 1
+        findings = (
+            self.script.pop(0) if len(self.script) > 1 else
+            (self.script[0] if self.script else [])
+        )
+        return {"verdict": "ok", "findings": list(findings), "t_wall": 0.0}
+
+
+class _FakeSup:
+    """The resize API surface the policy engine actuates, scriptable:
+    ``spawn_ok=False`` makes every landing attempt fail (the
+    pending-until-landed path)."""
+
+    def __init__(self, states=None, target=2):
+        self.states = dict(states if states is not None else {0: "live", 1: "live"})
+        self._target = target
+        self.calls = []
+        self.spawn_ok = True
+
+    @property
+    def target(self):
+        return self._target
+
+    def slot_states(self):
+        return dict(self.states)
+
+    def spawn_slot(self, i, *, origin="resize"):
+        self.calls.append(("spawn_slot", i))
+        if not self.spawn_ok:
+            return False
+        self.states[i] = "live"
+        return True
+
+    def retire_slot(self, i, *, origin="resize"):
+        self.calls.append(("retire_slot", i))
+        self.states[i] = "retired"
+        return True
+
+    def kill_actor(self, i):
+        self.calls.append(("kill_actor", i))
+        self.states[i] = "down"
+        return True
+
+    def set_target(self, n, *, lane_limit=None):
+        self.calls.append(("set_target", n))
+        spawned, retiring = [], []
+        active = sorted(
+            i for i, s in self.states.items() if s in ("live", "down")
+        )
+        while len(active) > n:
+            retiring.append(active.pop())
+            self.states[retiring[-1]] = "retired"
+        while len(active) < n:
+            lane = 0
+            while lane in active or self.states.get(lane) in (
+                "retired", "gave_up",
+            ):
+                lane += 1
+            if lane_limit is not None and lane >= lane_limit:
+                break
+            if not self.spawn_ok:
+                break
+            self.states[lane] = "live"
+            active.append(lane)
+            spawned.append(lane)
+        self._target = n
+        return {"spawned": spawned, "retiring": retiring}
+
+
+def _autoscaler(engine, sup, *, clock, ready=None, **cfg):
+    cfg.setdefault("min_actors", 1)
+    cfg.setdefault("max_actors", 4)
+    cfg.setdefault("fire_threshold", 3)
+    cfg.setdefault("cooldown_s", 30.0)
+    cfg.setdefault("eval_every_s", 1.0)
+    return Autoscaler(
+        engine,
+        sup,
+        config=AutoscaleConfig(**cfg),
+        clock=lambda: clock[0],
+        ready_fn=ready,
+    )
+
+
+# ------------------------------------------------------------ hysteresis math
+def test_fire_threshold_needs_consecutive_findings():
+    clock = [0.0]
+    down = [_finding("actors_down")]
+    eng = _FakeEngine(down)
+    sup = _FakeSup({0: "down", 1: "live"})
+    a = _autoscaler(eng, sup, clock=clock, fire_threshold=3)
+    assert a.tick(0.0) is None  # streak 1
+    assert a.tick(1.0) is None  # streak 2
+    assert sup.calls == []
+    act = a.tick(2.0)  # streak 3: fires
+    assert act is not None and act.kind == "spawn_actor" and act.slot == 0
+    assert ("spawn_slot", 0) in sup.calls
+
+
+def test_streak_resets_on_a_clean_evaluation():
+    clock = [0.0]
+    down = [_finding("actors_down")]
+    eng = _FakeEngine(down, down, [], down, down, down)
+    sup = _FakeSup({0: "down", 1: "live"})
+    a = _autoscaler(eng, sup, clock=clock, fire_threshold=3)
+    for t in range(2):
+        assert a.tick(float(t)) is None  # streak 1, 2
+    assert a.tick(2.0) is None  # clean tick: streak resets
+    assert a.tick(3.0) is None and a.tick(4.0) is None  # 1, 2 again
+    assert sup.calls == []
+    assert a.tick(5.0) is not None  # only NOW 3 consecutive
+
+
+def test_flapping_findings_produce_at_most_one_action_per_cooldown():
+    """The acceptance fixture: alternating starving/ok findings.  At
+    fire_threshold 1 (maximally twitchy) the cooldown ring still bounds
+    actuation to one action per window; at the default threshold the
+    streak never builds and NOTHING fires."""
+    starving = [_finding("learner_starving")]
+    # Maximally twitchy: threshold 1, so only the cooldown protects.
+    clock = [0.0]
+    eng = _FakeEngine(starving, [], starving, [], starving, [])
+    sup = _FakeSup({0: "live", 1: "live"})
+    a = _autoscaler(
+        eng, sup, clock=clock, fire_threshold=1, cooldown_s=30.0
+    )
+    landed = [a.tick(float(t)) for t in range(6)]  # one 30 s window
+    assert sum(x is not None for x in landed) <= 1
+    # Default threshold: the alternation never builds a streak — inert.
+    eng2 = _FakeEngine(starving, [], starving, [], starving, [])
+    sup2 = _FakeSup({0: "live", 1: "live"})
+    a2 = _autoscaler(eng2, sup2, clock=clock, fire_threshold=3)
+    assert all(a2.tick(float(t)) is None for t in range(6))
+    assert sup2.calls == []
+
+
+def test_cooldown_blocks_until_window_elapses():
+    clock = [0.0]
+    down = [_finding("actors_down")]
+    eng = _FakeEngine(down)
+    sup = _FakeSup({0: "down", 1: "down"})
+    a = _autoscaler(eng, sup, clock=clock, fire_threshold=1, cooldown_s=30.0)
+    assert a.tick(0.0) is not None  # lands on slot 0
+    assert a.tick(10.0) is None  # slot 1 still down, but cooling down
+    assert a.tick(29.9) is None
+    act = a.tick(30.0)
+    assert act is not None and act.slot == 1
+
+
+def test_actions_per_window_budget_caps_a_hot_rule():
+    clock = [0.0]
+    down = [_finding("actors_down")]
+    eng = _FakeEngine(down)
+    sup = _FakeSup({i: "down" for i in range(4)})
+    a = _autoscaler(
+        eng,
+        sup,
+        clock=clock,
+        fire_threshold=1,
+        cooldown_s=10.0,
+        window_s=300.0,
+        max_actions_per_window=2,
+    )
+    assert a.tick(0.0) is not None
+    assert a.tick(10.0) is not None
+    assert a.tick(20.0) is None  # budget spent: gated for the window
+    assert a.tick(100.0) is None
+    assert a.tick(300.0) is not None  # first action aged out of the window
+
+
+def test_warmup_exempts_replacement_but_gates_load_scaling():
+    clock = [0.0]
+    ready = [False]
+    # Load rule during warm-up: gated.
+    eng = _FakeEngine([_finding("learner_starving")])
+    sup = _FakeSup({0: "live", 1: "live"})
+    a = _autoscaler(
+        eng, sup, clock=clock, fire_threshold=1, ready=lambda: ready[0]
+    )
+    assert a.tick(0.0) is None and sup.calls == []
+    # Replacement during the same warm-up: acts (a dead process is a dead
+    # process, absorb or not).
+    eng2 = _FakeEngine([_finding("actors_down")])
+    sup2 = _FakeSup({0: "down", 1: "live"})
+    a2 = _autoscaler(
+        eng2, sup2, clock=clock, fire_threshold=1, ready=lambda: ready[0]
+    )
+    assert a2.tick(0.0) is not None
+    # And once steady, the same starving finding scales up.
+    ready[0] = True
+    assert a.tick(1.0) is not None
+
+
+def test_scale_up_respects_max_and_scale_down_respects_min():
+    clock = [0.0]
+    starving = [_finding("learner_starving")]
+    churn = [_finding("eviction_churn")]
+    sup = _FakeSup({0: "live", 1: "live"}, target=2)
+    a = _autoscaler(
+        _FakeEngine(starving),
+        sup,
+        clock=clock,
+        fire_threshold=1,
+        max_actors=2,  # already at the ceiling
+    )
+    assert a.tick(0.0) is None and sup.calls == []
+    sup2 = _FakeSup({0: "live"}, target=1)
+    a2 = _autoscaler(
+        _FakeEngine(churn), sup2, clock=clock, fire_threshold=1, min_actors=1
+    )
+    assert a2.tick(0.0) is None and sup2.calls == []  # at the floor
+    # In bounds, both act: up via set_target(+1), down via set_target(-1).
+    sup3 = _FakeSup({0: "live", 1: "live"}, target=2)
+    a3 = _autoscaler(
+        _FakeEngine(starving), sup3, clock=clock, fire_threshold=1,
+        max_actors=4,
+    )
+    act = a3.tick(0.0)
+    assert act is not None and act.kind == "spawn_actor" and act.goal == 3
+    assert ("set_target", 3) in sup3.calls
+    sup4 = _FakeSup({0: "live", 1: "live"}, target=2)
+    a4 = _autoscaler(
+        _FakeEngine(churn), sup4, clock=clock, fire_threshold=1, min_actors=1
+    )
+    act = a4.tick(0.0)
+    assert act is not None and act.kind == "kill_actor" and act.goal == 1
+    assert ("set_target", 1) in sup4.calls
+
+
+def test_starving_with_stale_actor_replaces_instead_of_scaling():
+    """Scale-up requires ALL actors fresh: a starving learner alongside a
+    wedged actor means replace the wedge, not mask it with population."""
+    clock = [0.0]
+    eng = _FakeEngine(
+        [
+            _finding("learner_starving"),
+            _finding("telem_stale", detail="actor 1 TELEM stale — wedged"),
+        ]
+    )
+    sup = _FakeSup({0: "live", 1: "live"})
+    a = _autoscaler(eng, sup, clock=clock, fire_threshold=1)
+    act_landed = a.tick(0.0)
+    # Stage 1 of replace: the kill (pending until the respawn lands).
+    assert act_landed is None
+    assert ("kill_actor", 1) in sup.calls
+    assert not any(c[0] == "set_target" for c in sup.calls)
+    act = a.tick(1.0)  # slot now "down": stage 2 spawns — lands
+    assert act is not None and act.kind == "replace_actor" and act.slot == 1
+    assert ("spawn_slot", 1) in sup.calls
+
+
+def test_pending_until_landed_never_double_spawns():
+    """An actuation that cannot land (mid-backoff lane) stays pending and
+    is retried next tick — no new decisions, no second action, and
+    exactly one autoscale_action once it lands."""
+    clock = [0.0]
+    eng = _FakeEngine([_finding("actors_down")])
+    sup = _FakeSup({0: "down", 1: "live"})
+    sup.spawn_ok = False  # the lane refuses to land (ladder owns it)
+    a = _autoscaler(eng, sup, clock=clock, fire_threshold=1)
+    n0 = len(get_flight_recorder().events())
+    assert a.tick(0.0) is None
+    assert a.stats()["autoscale_pending"] == "spawn_actor"
+    evals = eng.evaluations
+    assert a.tick(1.0) is None  # retry, still not landing
+    assert eng.evaluations == evals  # no new evaluation while pending
+    sup.spawn_ok = True
+    act = a.tick(2.0)
+    assert act is not None and a.stats()["autoscale_pending"] is None
+    actions = [
+        e
+        for e in get_flight_recorder().events()[n0:]
+        if e["kind"] == "autoscale_action"
+    ]
+    assert len(actions) == 1
+    assert sum(1 for c in sup.calls if c == ("spawn_slot", 0)) == 3
+
+
+def test_pending_replacement_superseded_by_ladder_recovery():
+    """A pending respawn whose slot comes back on its own (the reflexive
+    ladder beat the policy to it) is dropped WITHOUT an autoscale_action
+    — nothing was actuated, so nothing may claim it was."""
+    clock = [0.0]
+    eng = _FakeEngine([_finding("actors_down")])
+    sup = _FakeSup({0: "down", 1: "live"})
+    sup.spawn_ok = False
+    a = _autoscaler(eng, sup, clock=clock, fire_threshold=1)
+    assert a.tick(0.0) is None  # pending
+    sup.states[0] = "live"  # the ladder respawned it meanwhile
+    n0 = len(get_flight_recorder().events())
+    assert a.tick(1.0) is None
+    assert a.stats()["autoscale_pending"] is None
+    assert not any(
+        e["kind"] == "autoscale_action"
+        for e in get_flight_recorder().events()[n0:]
+    )
+
+
+def test_dry_run_logs_decisions_but_never_actuates():
+    clock = [0.0]
+    eng = _FakeEngine([_finding("actors_down")])
+    sup = _FakeSup({0: "down", 1: "live"})
+    a = _autoscaler(eng, sup, clock=clock, fire_threshold=1, dry_run=True)
+    n0 = len(get_flight_recorder().events())
+    assert a.tick(0.0) is None
+    assert sup.calls == []  # nothing moved
+    s = a.stats()
+    assert s["autoscale_dry_run_decisions"] == 1
+    assert sum(s["autoscale_actions"].values()) == 0
+    events = get_flight_recorder().events()[n0:]
+    decisions = [e for e in events if e["kind"] == "autoscale_decision"]
+    assert decisions and decisions[0]["dry_run"] and decisions[0]["fired"]
+    assert not any(e["kind"] == "autoscale_action" for e in events)
+    # The hysteresis clock ticked: an immediate second decision cools down.
+    assert a.tick(1.0) is None
+    assert a.stats()["autoscale_dry_run_decisions"] == 1
+
+
+def test_shards_down_respawns_through_the_tier():
+    class _Tier:
+        def __init__(self):
+            self.supervisor = _FakeSup({0: "gave_up"})
+
+    clock = [0.0]
+    tier = _Tier()
+    eng = _FakeEngine([_finding("shards_down")])
+    a = Autoscaler(
+        eng,
+        _FakeSup({0: "live", 1: "live"}),
+        shard_tier=tier,
+        config=AutoscaleConfig(fire_threshold=1, max_actors=4),
+        clock=lambda: clock[0],
+    )
+    act = a.tick(0.0)
+    assert act is not None and act.kind == "respawn_shard_proc"
+    assert ("spawn_slot", 0) in tier.supervisor.calls
+
+
+# ------------------------------------------------------- kill-drill e2e
+def test_autoscale_kill_drill_restores_population(tmp_path):
+    """The acceptance drill (non-slow; autoscale_gate runs it): a live
+    2-actor fleet, ``kill_actor@p3``, supervisor in policy mode — the
+    AUTOSCALER restores the target population (autoscale_action paired
+    with an origin="autoscale" spawn, restarts_total == 0: planned
+    recovery, not the reflexive crash-restart), counters stay monotone,
+    accounting is not lost, sheds == 0."""
+    from r2d2dpg_tpu import obs
+    from r2d2dpg_tpu.fleet.actor import FleetActor
+
+    seed = 0
+    num_actors = 2
+    spec = "kill_actor@p3"
+    trainer = PENDULUM_TINY.build()
+    # Deep queue + patient shed deadline: no chaos fault paces these
+    # actors (the one kill hits a supervised sleeper), so they run the
+    # ingest queue full flat-out and a post-steady compile gap would trip
+    # the 1 s default — this drill's sheds==0 claim is about the
+    # RECOVERY dropping nothing, not about the shed contract (pinned by
+    # the backpressure tests).
+    learner = FleetLearner(
+        trainer,
+        FleetConfig(
+            num_actors=num_actors,
+            queue_depth=32,
+            idle_timeout_s=120,
+            shed_after_s=30.0,
+        ),
+    )
+    address = learner.start()
+    actors = [
+        FleetActor(
+            PENDULUM_TINY,
+            actor_id=i,
+            num_actors=num_actors,
+            address=address,
+            seed=seed,
+        )
+        for i in range(num_actors)
+    ]
+
+    def actor_loop(a):
+        try:
+            a.run(max_phases=400)
+        except Exception:  # noqa: BLE001 — server teardown cuts the socket
+            pass
+
+    threads = [
+        threading.Thread(target=actor_loop, args=(a,), daemon=True)
+        for a in actors
+    ]
+    # The kill victims: supervised jax-free sleepers in POLICY mode — a
+    # crash leaves the slot down for the autoscaler, never the ladder.
+    sup = ActorSupervisor(
+        lambda i: [sys.executable, "-c", "import time; time.sleep(600)"],
+        num_actors,
+        config=SupervisorConfig(poll_s=0.05, restart="policy"),
+    )
+    engine = ChaosEngine(
+        parse_chaos_spec(spec),
+        seed=seed,
+        num_actors=num_actors,
+        supervisor=sup,
+        server=learner.server,
+    )
+    # telem_expected=False: the drill's experience carriers are in-process
+    # threads with no --telem-every cadence (train.py derives this from
+    # the resolved --obs-fleet) — a growing staleness clock here is not a
+    # wedge, and judging it would have the policy loop replacing healthy
+    # sleepers until the window budget starves the REAL recovery.
+    health = obs.HealthEngine(
+        obs.HealthConfig(expected_actors=num_actors, telem_expected=False),
+        registry=obs.get_registry(),
+    )
+    scaler = Autoscaler(
+        health,
+        sup,
+        config=AutoscaleConfig(
+            min_actors=1,
+            max_actors=num_actors,
+            fire_threshold=2,
+            cooldown_s=0.2,
+            window_s=60.0,
+            max_actions_per_window=4,
+            eval_every_s=0.05,
+        ),
+    )
+    n_train = 8
+    rows = []
+    n0 = len(get_flight_recorder().events())
+    for t in threads:
+        t.start()
+    try:
+        sup.start()
+        scaler.start()
+        state = learner.run(
+            n_train,
+            log_every=2,
+            metrics_fn=lambda p, s: rows.append((p, dict(s))),
+            phase_fn=engine.on_phase,
+        )
+        # Hold the fleet up until the autoscaler's replacement lands (the
+        # learner can burn its queue backlog before the ~0.1 s policy
+        # loop reacts — same race the chaos drill test holds open).
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and (
+            sup.alive_count() < num_actors
+            or sum(scaler.stats()["autoscale_actions"].values()) < 1
+        ):
+            time.sleep(0.05)
+        alive_restored = sup.alive_count()  # before teardown reaps the fleet
+    finally:
+        scaler.stop()
+        sup.stop()
+        learner.close()
+        for t in threads:
+            t.join(timeout=30)
+
+    # 1. The run completed its schedule; the drill fired.
+    assert int(state.train.step) == n_train * trainer.config.learner_steps
+    stats = learner.stats()
+    assert stats["train_phases"] == n_train
+    assert not engine.unfired()
+    assert stats["sheds"] == 0
+
+    # 2. Monotone counters, accounting preserved.
+    env_steps = [s["env_steps"] for _, s in rows]
+    assert env_steps == sorted(env_steps) and env_steps[-1] > 0
+
+    # 3. Population restored BY POLICY: an autoscale_action paired with
+    # an origin="autoscale" spawn on the killed slot — and zero ladder
+    # restarts (the planned version of crash-restart).
+    events = get_flight_recorder().events()[n0:]
+    kill_target = next(
+        e["actor"]
+        for e in events
+        if e["kind"] == "chaos_inject" and e["fault"] == "kill_actor"
+    )
+    assert any(
+        e["kind"] == "actor_crash" and e.get("actor") == kill_target
+        for e in events
+    )
+    actions = [e for e in events if e["kind"] == "autoscale_action"]
+    assert any(
+        a["action"] == "spawn_actor"
+        and a["slot"] == kill_target
+        and a["rule"] == "actors_down"
+        for a in actions
+    )
+    spawns = [
+        e
+        for e in events
+        if e["kind"] == "actor_spawn"
+        and e.get("actor") == kill_target
+        and e.get("origin") == "autoscale"
+    ]
+    assert spawns, "the replacement spawn must be attributed to autoscale"
+    assert not any(e["kind"] == "actor_restart" for e in events)
+    assert sup.restarts_total == 0
+    assert alive_restored == num_actors
+
+    # 4. Time-to-recover reads off the flight timeline (the bench leg's
+    # column): kill -> the autoscale spawn.
+    t_kill = next(
+        e["t_mono"]
+        for e in events
+        if e["kind"] == "chaos_inject" and e["fault"] == "kill_actor"
+    )
+    t_restore = spawns[0]["t_mono"]
+    assert t_restore >= t_kill
