@@ -1181,6 +1181,120 @@ def _shard_procs_leg(phases: int = 12) -> dict:
     return leg
 
 
+def _shard_direct_leg(phases: int = 12) -> dict:
+    """``python bench.py fleet_shard_direct`` — the direct actor->shard
+    data plane (ISSUE 17): two lane-matched sub-runs of ``--actors 3
+    --replay-shards 2 --shard-procs 2`` through the real train.py CLI,
+    one with ``--shard-direct 1`` (+ concurrent pullers and one phase of
+    batch prefetch), one on the learner-forwarded path with the SERIAL
+    pull loop (``--shard-direct 0 --shard-pullers 1`` — the pre-ISSUE-17
+    control).
+
+    The claims the direct leg records: ``shard_forward_bytes == 0``
+    (every staged batch bypassed the learner's ingest->shard hop — the
+    seam the ROADMAP named after ISSUE 12), ``learner_seqs_bytes``
+    collapsed to K_STATS control frames (recorded per trained sequence
+    against the control leg's full forwarded stream), sheds == 0,
+    steady_recompiles == 0, and ``sampler_wait_p99_ms`` at or under the
+    serial control leg's (N pullers pay ~the max per-shard exchange,
+    the serial loop pays the sum).
+
+    HONESTY (the standing fleet-leg caveat): this container time-slices
+    the learner, 3 actors and 2 shard procs on shared cores, so
+    wait/throughput columns are contention-noisy — the byte counters
+    and the zero/nonzero structural claims are the stable evidence;
+    treat the p99 comparison as directional on this box."""
+
+    def sub_run(tag: str, extra_args: list) -> dict:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("R2D2DPG_PALLAS_INTERPRET", "1")
+        cmd = [
+            sys.executable, "-m", "r2d2dpg_tpu.train",
+            "--config", "pendulum_r2d2", "--num-envs", "64",
+            "--actors", "3", "--replay-shards", "2", "--shard-procs", "2",
+            "--fleet-publish-every", "4",
+            # Lane-matched to fleet_sampler/fleet_shard_procs so byte
+            # columns compare across legs, not across encodings.
+            "--fleet-wire", "bf16", "--fleet-compress", "zlib",
+            "--phases", str(phases), "--log-every", "0",
+        ] + extra_args
+        rc, stdout, stderr = _run_leg_cmd(cmd, env)
+        if rc is None:
+            return {"error": f"shard-direct {tag} leg exceeded 900s"}
+        stats = _parse_fleet_stats(stdout)
+        if not stats:
+            return {"error": f"rc={rc}: {stderr[-300:]}"}
+        trained = max(stats.get("trained_seqs", 0.0), 1.0)
+        leg = {
+            "trained_seqs": stats.get("trained_seqs", 0.0),
+            "sheds": stats.get("sheds", -1.0),
+            # The shed hop, as a counter: ingest->shard SEQS bytes the
+            # learner forwarded (0 on the direct leg is the tentpole).
+            "shard_forward_bytes": stats.get(
+                "shard_forward_bytes_total", -1.0
+            ),
+            # The actor->learner wire per trained sequence: K_STATS-only
+            # on the direct leg vs the full forwarded stream.
+            "learner_seqs_bytes": stats.get("seqs_bytes_total", 0.0),
+            "learner_wire_bytes_per_trained_seq": round(
+                stats.get("seqs_bytes_total", 0.0) / trained, 1
+            ),
+            "sample_bytes_total": stats.get("sample_bytes_total", 0.0),
+            "bytes_per_trained_seq": round(
+                stats.get("bytes_per_trained_seq", 0.0), 1
+            ),
+            "shard_pullers": stats.get("shard_pullers", 0.0),
+            # Starvation signal, one sample per phase zeros included:
+            # 0.0 IS the healthy reading (see sampler.py's
+            # _pull_phase_batches docstring), so the cross-leg claim is
+            # "no worse", not a ratio.
+            "sampler_wait_p99_ms": round(
+                stats.get("sampler_wait_p99_ms", 0.0), 3
+            ),
+            "sampler_wait_total_s": round(
+                stats.get("sampler_wait_total_s", 0.0), 3
+            ),
+            # Per-exchange SAMPLE_REQ/BATCH latency: the serial leg
+            # pays the SUM of these per phase, K pullers pay ~the max
+            # per round — on this time-sliced box the per-exchange p99
+            # rises under concurrency while phase wall clock drops, so
+            # both the p99 and the total are recorded.
+            "puller_wait_p99_ms": round(
+                stats.get("puller_wait_p99_ms", 0.0), 3
+            ),
+            "puller_wait_total_s": round(
+                stats.get("puller_wait_total_s", 0.0), 3
+            ),
+            "learner_steps_per_sec": round(
+                stats.get("train_learner_steps_per_sec", 0.0), 2
+            ),
+            "evictions": stats.get("evictions", 0.0),
+            **_device_cols(stats),
+        }
+        if rc != 0:
+            leg["error"] = f"rc={rc}: {stderr[-300:]}"
+        return leg
+
+    direct = sub_run(
+        "direct",
+        ["--shard-direct", "1", "--shard-prefetch", "1"],
+    )
+    control = sub_run(
+        "forwarded-serial",
+        ["--shard-direct", "0", "--shard-pullers", "1"],
+    )
+    leg = {"direct": direct, "forwarded_serial": control}
+    if "error" not in direct and "error" not in control:
+        leg["forward_bytes_shed"] = control["shard_forward_bytes"]
+        leg["sampler_wait_p99_le_serial"] = bool(
+            direct["sampler_wait_p99_ms"]
+            <= control["sampler_wait_p99_ms"]
+        )
+    return leg
+
+
 def _autoscale_leg(phases: int = 12) -> dict:
     """``python bench.py fleet_autoscale`` — the policy-driven recovery
     probe (ISSUE 16): a 3-actor fleet through the real train.py CLI with
@@ -1433,5 +1547,10 @@ if __name__ == "__main__":
         # CPU-local, kill_actor drill under --autoscale 1): ONE JSON
         # object — merge into BENCH_FLEET.json's "fleet_autoscale" key.
         print(json.dumps({"fleet_autoscale": _autoscale_leg()}))
+    elif len(sys.argv) > 1 and sys.argv[1] == "fleet_shard_direct":
+        # Just the direct-data-plane leg (ISSUE 17; two subprocess
+        # sub-runs, direct vs forwarded-serial, CPU-local): ONE JSON
+        # object — merge into BENCH_FLEET.json's "fleet_shard_direct".
+        print(json.dumps({"fleet_shard_direct": _shard_direct_leg()}))
     else:
         main()

@@ -49,6 +49,7 @@ from r2d2dpg_tpu.fleet.transport import (
     K_HELLO,
     K_PARAMS,
     K_SEQS,
+    K_STATS,
     K_TELEM,
     FrameError,
     PeerDeadError,
@@ -71,6 +72,7 @@ from r2d2dpg_tpu.training.trainer import Trainer, TrainerConfig
 from r2d2dpg_tpu.utils.codes import (
     EXIT_AUTH_REFUSED,
     EXIT_WIRE_REFUSED,
+    OK,
     REFUSED_AUTH,
     REFUSED_WIRE,
     SHED_INGEST,
@@ -148,6 +150,7 @@ class FleetActor:
         read_deadline_s: float = READ_DEADLINE_S,
         warmup_deadline_s: float = 120.0,
         auth_token: Optional[str] = None,
+        shard_direct: bool = False,
         chaos_spec: Optional[str] = None,
         reconnect_tries: int = 4,
         reconnect_base_s: float = 0.5,
@@ -233,6 +236,19 @@ class FleetActor:
         self._pending_stats = {
             "env_steps_delta": 0.0, "ep_return_sum": 0.0, "ep_count": 0.0,
         }
+        # Direct data plane (ISSUE 17): when the learner advertises a shard
+        # assignment on an ack, dial the shard and ship SEQS to it directly
+        # — the control connection then carries only a tiny K_STATS frame
+        # per phase (params/telem/accounting), shedding the ingest forward
+        # hop from the experience path.  Any data-leg failure falls back
+        # LOUDLY to the learner-forwarded path; _pending_stats is cleared
+        # only on a control-plane ack, so accounting is plane-independent.
+        self.shard_direct = bool(shard_direct)
+        self._assignment: Optional[dict] = None  # last dialed advert
+        self._failed_assignment: Optional[dict] = None  # don't re-hammer
+        self._data_sock = None  # live => ship SEQS direct
+        self._data_packer: Optional[wire.TreePacker] = None
+        self._data_epoch = -1  # epoch the data HELLO ack pinned
         # Actor-side chaos faults (fleet/chaos.py): the forwarded
         # --chaos-spec's stall/corrupt drills that target THIS actor.
         self.chaos: Optional[fleet_chaos.ActorChaos] = None
@@ -284,6 +300,25 @@ class FleetActor:
             "r2d2dpg_actor_reconnects_total",
             "successful in-process reconnects after a torn connection "
             "(fresh socket + HELLO + param snapshot, same incarnation)",
+        )
+        # Per-plane byte accounting (ISSUE 17 satellite): the data leg's
+        # bytes land here and ONLY here — never in the actor/control
+        # totals above — so control-vs-data traffic stays separable.  The
+        # r2d2dpg_fleet_ prefix keeps these out of the shard TELEM echo.
+        self._obs_data_out = reg.counter(
+            "r2d2dpg_fleet_data_bytes_out_total",
+            "bytes sent on the direct actor->shard data plane",
+            labelnames=("plane",),
+        ).labels(plane="data")
+        self._obs_data_in = reg.counter(
+            "r2d2dpg_fleet_data_bytes_in_total",
+            "bytes received on the direct actor->shard data plane",
+            labelnames=("plane",),
+        ).labels(plane="data")
+        self._obs_fallback = reg.counter(
+            "r2d2dpg_actor_data_fallback_total",
+            "direct data-plane failures that fell back to the "
+            "learner-forwarded path (dial refused, torn leg, partition)",
         )
         self._session_delivered = False
 
@@ -470,6 +505,11 @@ class FleetActor:
             if reconnected:
                 flight_event("actor_reconnect", phase=self._phase)
                 self._obs_reconnects.inc()
+            # The HELLO ack may carry the shard assignment advert
+            # (ingest._assignment waits for the tier at HELLO time): dial
+            # the data plane before the first phase so the forward hop is
+            # shed from batch one, not batch two.
+            self._maybe_update_assignment(hello_ack)
             self._maybe_send_telem(sock, force=True)
             while (
                 max_phases is None or self._phase < max_phases
@@ -479,6 +519,13 @@ class FleetActor:
                     # exactly what a wedged env or GC pause looks like on
                     # the wire — the ingest handler's heartbeat reaps us.
                     self.chaos.maybe_stall(self._batches + 1)
+                    if self.chaos.partition_data_plane(self._batches + 1):
+                        # The partition drill: sever the data leg under
+                        # our feet (shutdown, reference kept) so the next
+                        # direct send hits a dead socket and the LOUD
+                        # fallback path runs — the control plane keeps
+                        # the accounting whole throughout.
+                        self._partition_data_plane()
                 # Trace sampling decided at collection time (obs/trace.py):
                 # rate 0 allocates nothing and the frame is byte-identical
                 # to an untraced wire.
@@ -522,38 +569,69 @@ class FleetActor:
                 self._pending_stats["env_steps_delta"] += steps_delta
                 self._pending_stats["ep_return_sum"] += float(ret_sum)
                 self._pending_stats["ep_count"] += float(count)
-                # The steady-state hot path: schema-cached binary frames
-                # (fleet/wire.py), tensor bytes streamed without an
-                # intermediate payload join (send_frame_parts).
-                parts = packer.pack(
-                    {
-                        "phase": self._phase,
-                        "param_version": self._param_version,
-                        **self._pending_stats,
-                        "staged": StagedSequences(
-                            seq=seq_host, priorities=prios_host
-                        ),
-                    },
-                    trace=tr,
+                staged_host = StagedSequences(
+                    seq=seq_host, priorities=prios_host
                 )
-                if self.chaos is not None and self.chaos.corrupt_next_frame(
-                    self._batches
-                ):
-                    # The corrupt-frame drill: pristine CRC over flipped
-                    # bytes — the server MUST reject it (FrameCRCError)
-                    # and kill the connection; we reconnect and re-bank.
+                sent_direct = self._data_sock is not None and (
+                    self._send_direct(staged_host)
+                )
+                if sent_direct:
+                    # Experience is shard-owned; only the accounting
+                    # deltas ride the control connection now — a tiny
+                    # pickled K_STATS frame, acked like SEQS so the
+                    # at-least-once clear below is plane-independent.
                     self._obs_bytes_out.inc(
-                        fleet_chaos.send_corrupt_frame(sock, K_SEQS, parts)
-                    )
-                else:
-                    self._obs_bytes_out.inc(
-                        send_frame_parts(
+                        send_frame(
                             sock,
-                            K_SEQS,
-                            parts,
+                            K_STATS,
+                            pack_obj(  # wire-lint: control
+                                {
+                                    "phase": self._phase,
+                                    "param_version": self._param_version,
+                                    **self._pending_stats,
+                                }
+                            ),
                             max_frame_bytes=self.max_frame_bytes,
                         )
                     )
+                else:
+                    # The learner-forwarded path: steady state when
+                    # --shard-direct is off, the LOUD fallback when the
+                    # data leg just died (the staged batch that failed
+                    # mid-push retries here — nothing is dropped).
+                    # Schema-cached binary frames (fleet/wire.py), tensor
+                    # bytes streamed without an intermediate payload join
+                    # (send_frame_parts).
+                    parts = packer.pack(
+                        {
+                            "phase": self._phase,
+                            "param_version": self._param_version,
+                            **self._pending_stats,
+                            "staged": staged_host,
+                        },
+                        trace=tr,
+                    )
+                    if self.chaos is not None and (
+                        self.chaos.corrupt_next_frame(self._batches)
+                    ):
+                        # The corrupt-frame drill: pristine CRC over
+                        # flipped bytes — the server MUST reject it
+                        # (FrameCRCError) and kill the connection; we
+                        # reconnect and re-bank.
+                        self._obs_bytes_out.inc(
+                            fleet_chaos.send_corrupt_frame(
+                                sock, K_SEQS, parts
+                            )
+                        )
+                    else:
+                        self._obs_bytes_out.inc(
+                            send_frame_parts(
+                                sock,
+                                K_SEQS,
+                                parts,
+                                max_frame_bytes=self.max_frame_bytes,
+                            )
+                        )
                 ack = self._await_ack(sock)
                 # Acked (OK or shed): the server owns the accounting now —
                 # OK folds it with the batch, a shed banks it server-side.
@@ -568,12 +646,20 @@ class FleetActor:
                 if ack["code"] == SHED_INGEST:
                     self._sheds += 1
                     self._obs_shed.inc()
+                # Every control ack may carry a (re-)advert: the first
+                # one after an epoch-bumped shard rejoin re-dials the new
+                # incarnation; an unchanged advert on a live leg is a
+                # no-op.
+                self._maybe_update_assignment(ack)
                 self._maybe_send_telem(sock)
             try:
                 send_frame(sock, K_BYE, b"")  # wire-lint: control
             except OSError:
                 pass
         finally:
+            # The data leg lives and dies with the control session: a
+            # reconnect re-dials from the fresh HELLO ack's advert.
+            self._drop_data_plane(reason=None)
             try:
                 sock.close()
             except OSError:
@@ -608,6 +694,176 @@ class FleetActor:
                 max_frame_bytes=self.max_frame_bytes,
             )
         )
+
+    # ------------------------------------------------- direct data plane
+    def _maybe_update_assignment(self, ack: Any) -> None:
+        """Track the learner's shard-assignment advert; (re)dial the data
+        plane when it changes.
+
+        The advert rides control acks (HELLO/SEQS/STATS), so this runs at
+        most once per phase — natural rate limiting on re-dials.  An
+        advert identical to the last FAILED one is skipped (no hammering
+        a refusing shard every phase); the learner re-adverts with a
+        bumped epoch once the shard rejoins, which unsticks us."""
+        if not self.shard_direct or not isinstance(ack, dict):
+            return
+        advert = ack.get("shard_assignment")
+        if not isinstance(advert, dict):
+            return
+        if advert == self._failed_assignment:
+            return
+        if (
+            self._data_sock is not None
+            and self._assignment is not None
+            and advert.get("address") == self._assignment.get("address")
+            and int(advert.get("epoch", -1)) == self._data_epoch
+        ):
+            return  # same shard incarnation, leg already live
+        self._dial_data_plane(advert)
+
+    def _dial_data_plane(self, advert: dict) -> bool:
+        """Dial the advertised shard: connect + plane="data" HELLO (same
+        token as the control HELLO) + OK ack.  A refusal or dead address
+        is LOUD but non-fatal — the learner-forwarded path keeps the
+        experience flowing."""
+        address = str(advert.get("address") or "")
+        if not address:
+            return False
+        self._drop_data_plane(reason=None)  # replace any previous leg
+        sock = None
+        try:
+            sock = connect(address, read_deadline_s=self.read_deadline_s)
+            hello = {
+                "actor_id": self.actor_id,
+                "plane": "data",
+                **wire.negotiation_fields(self.wire_config),
+            }
+            if self.auth_token is not None:
+                hello["auth"] = hello_auth_proof(self.auth_token)
+            self._obs_data_out.inc(
+                send_frame(
+                    sock,
+                    K_HELLO,
+                    pack_hello(hello),
+                    max_frame_bytes=self.max_frame_bytes,
+                )
+            )
+            hello_ack = self._await_data_ack(sock)
+            if hello_ack.get("code") != OK:
+                raise FrameError(
+                    f"shard refused data-plane HELLO: "
+                    f"code={hello_ack.get('code')} "
+                    f"reason={hello_ack.get('reason')}"
+                )
+        except (FrameError, OSError) as e:
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._failed_assignment = dict(advert)
+            self._obs_fallback.inc()
+            flight_event(
+                "data_plane_dial_failed",
+                phase=self._phase,
+                shard=advert.get("shard"),
+                address=address,
+                error=f"{type(e).__name__}: {e}",
+            )
+            return False
+        self._data_sock = sock
+        # Fresh packer per leg: its first SEQS frame re-inlines the
+        # schema, exactly like a control reconnect.
+        self._data_packer = wire.TreePacker(
+            self.wire_config, max_frame_bytes=self.max_frame_bytes
+        )
+        self._data_epoch = int(advert.get("epoch", -1))
+        self._assignment = dict(advert)
+        self._failed_assignment = None
+        flight_event(
+            "data_plane_dialed",
+            phase=self._phase,
+            shard=advert.get("shard"),
+            address=address,
+            epoch=self._data_epoch,
+        )
+        return True
+
+    def _send_direct(self, staged: StagedSequences) -> bool:
+        """Ship one staged batch straight to the shard; True only once
+        its ack lands.  ANY failure tears the leg down loudly and returns
+        False — the caller then sends the SAME batch on the control
+        connection, so a mid-push shard death drops nothing."""
+        try:
+            parts = self._data_packer.pack({"staged": staged})
+            self._obs_data_out.inc(
+                send_frame_parts(
+                    self._data_sock,
+                    K_SEQS,
+                    parts,
+                    max_frame_bytes=self.max_frame_bytes,
+                )
+            )
+            ack = self._await_data_ack(self._data_sock)
+            if ack.get("code") != OK:
+                raise FrameError(
+                    f"shard data-plane ack code {ack.get('code')}"
+                )
+            return True
+        except (FrameError, OSError) as e:
+            self._drop_data_plane(reason=f"{type(e).__name__}: {e}")
+            return False
+
+    def _await_data_ack(self, sock) -> Any:
+        """Read to the shard's next ACK on the data leg.  The shard rides
+        TELEM pushes on any authenticated connection — the learner is
+        their consumer, so here they are counted and dropped."""
+        while True:
+            kind, payload = recv_frame_heartbeat(
+                sock,
+                max_frame_bytes=self.max_frame_bytes,
+                bytes_in=self._obs_data_in.inc,
+                bytes_out=self._obs_data_out.inc,
+            )
+            self._obs_data_in.inc(HEADER_BYTES + len(payload))
+            if kind == K_TELEM:
+                continue
+            if kind == K_ACK:
+                return unpack_obj(payload)  # wire-lint: control
+            raise FrameError(f"unexpected data-plane frame kind {kind}")
+
+    def _drop_data_plane(self, reason: Optional[str]) -> None:
+        """Tear down the data leg.  A non-None reason is a FAILURE — loud
+        flight event + fallback counter; None is lifecycle (session end,
+        re-dial replacing the leg)."""
+        sock, self._data_sock = self._data_sock, None
+        self._data_packer = None
+        self._assignment = None
+        self._data_epoch = -1
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if reason is not None:
+            self._obs_fallback.inc()
+            flight_event(
+                "data_plane_fallback",
+                phase=self._phase,
+                error=reason,
+            )
+
+    def _partition_data_plane(self) -> None:
+        """Chaos partition_data_plane: sever the leg at the transport
+        (shutdown both directions) but KEEP the reference, so the next
+        direct send surfaces the failure exactly like a real network
+        partition would — mid-send, not at dial time."""
+        if self._data_sock is None:
+            return
+        try:
+            self._data_sock.shutdown(socket_mod.SHUT_RDWR)
+        except OSError:
+            pass
 
     def _await_ack(self, sock) -> Any:
         """Read to the next ACK, applying any PARAMS pushed ahead of it
@@ -724,6 +980,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="shared HELLO-authentication secret; defaults to "
                    "$R2D2DPG_FLEET_TOKEN (the spawner passes the secret "
                    "via the environment so it never shows in ps)")
+    # Direct data plane (ISSUE 17; train.py --shard-direct forwards it).
+    p.add_argument("--shard-direct", type=int, default=0, choices=[0, 1],
+                   help="1: dial the learner-advertised replay shard and "
+                   "ship SEQS to it directly (control connection carries "
+                   "params/telem/accounting only); falls back loudly to "
+                   "the learner-forwarded path on any data-leg failure")
     p.add_argument("--chaos-spec", default=None,
                    help="seeded chaos schedule (fleet/chaos.py grammar); "
                    "this actor fires the stall/corrupt faults that target "
@@ -810,6 +1072,7 @@ def main(argv=None) -> None:
             trace_sample=args.trace_sample,
             read_deadline_s=args.read_deadline,
             auth_token=auth_token,
+            shard_direct=bool(args.shard_direct),
             chaos_spec=args.chaos_spec,
         )
     except ValueError as e:

@@ -37,6 +37,13 @@ fault                injection boundary             documented recovery
                      legs' connections to one       shard data survives
                      shard (``RemoteShardSet.       under the SAME epoch (a
                      partition``)                   partition ≠ a restart)
+``partition_data_    actor-side severing of its     loud fallback to the
+plane``              direct actor->shard data leg   learner-forwarded SEQS
+                     (``FleetActor._partition_      path (``data_plane_
+                     data_plane``: shutdown, ref    fallback``), accounting
+                     kept — the next send fails     intact, re-dial on the
+                     mid-push like a real           next assignment advert
+                     partition)
 ===================  =============================  ========================
 
 **Spec grammar** (``--chaos-spec``)::
@@ -108,7 +115,15 @@ LEARNER_FAULTS = frozenset(
         "partition_shard",
     }
 )
-ACTOR_FAULTS = frozenset({"stall_actor", "corrupt_frame"})
+# ``partition_data_plane`` drills the direct actor->shard data leg
+# (ISSUE 17): the actor severs its own data socket at the transport and
+# the next direct push fails mid-send — recovery is the LOUD fallback to
+# the learner-forwarded path with accounting intact, then a re-dial off
+# the next assignment advert.  train.py refuses it without --shard-direct
+# (no data plane to partition).
+ACTOR_FAULTS = frozenset(
+    {"stall_actor", "corrupt_frame", "partition_data_plane"}
+)
 # Faults fired INSIDE a standalone shard process (fleet/shard.py parses
 # the forwarded --chaos-spec; the clock is SEQS frames that process has
 # absorbed).  ``kill_shard`` targets a shard PROCESS index (the
@@ -123,6 +138,10 @@ SAMPLER_FAULTS = frozenset({"kill_sampler_conn", "stall_sampler"})
 # shards share the learner's process — there is no shard to kill,
 # partition, or stall independently of the learner itself).
 SHARD_FAULTS = frozenset({"kill_shard", "stall_shard", "partition_shard"})
+# The direct-data-plane class: refused without --shard-direct (with the
+# experience riding the learner-forwarded path there is no data leg to
+# partition — the drill would silently no-op).
+DIRECT_FAULTS = frozenset({"partition_data_plane"})
 FAULT_KINDS = tuple(sorted(LEARNER_FAULTS | ACTOR_FAULTS | SHARD_PROC_FAULTS))
 # Faults that carry (and require) a :Ds duration suffix.
 STALL_FAULTS = frozenset({"stall_actor", "stall_sampler", "stall_shard"})
@@ -472,6 +491,18 @@ class ActorChaos:
         """True when batch ``batch_idx``'s SEQS frame should go out through
         ``send_corrupt_frame`` (fires each due corrupt fault once)."""
         due = self._due("corrupt_frame", batch_idx)
+        for f in due:
+            self._fired.add(f.index)
+            record_injection(f, self.actor_id, at_phase=batch_idx)
+        return bool(due)
+
+    def partition_data_plane(self, batch_idx: int) -> bool:
+        """True when the direct data leg should be severed before batch
+        ``batch_idx`` (fires each due partition fault once) — the actor
+        shuts the socket down but keeps the reference, so the coming
+        direct push fails mid-send like a real network partition and the
+        loud-fallback recovery path runs."""
+        due = self._due("partition_data_plane", batch_idx)
         for f in due:
             self._fired.add(f.index)
             record_injection(f, self.actor_id, at_phase=batch_idx)

@@ -62,6 +62,7 @@ from r2d2dpg_tpu.fleet.transport import (
     K_HELLO,
     K_PARAMS,
     K_SEQS,
+    K_STATS,
     K_TELEM,
     FrameError,
     PeerDeadError,
@@ -128,6 +129,19 @@ class FleetConfig:
     # auth.  REQUIRED before binding a routable (non-loopback) address on
     # anything but a trusted network.
     auth_token: Optional[str] = None
+    # Split-plane wire (ISSUE 17): actors dial their shard DIRECTLY for
+    # SEQS (the ingest ack carries the assignment + dialable address),
+    # keeping the learner connection as a control plane for HELLO/params/
+    # TELEM/accounting.  Requires the standalone shard tier; the actor
+    # falls back LOUDLY to learner-forwarded SEQS when the direct dial is
+    # refused, partitioned, or the tier is in-learner.
+    shard_direct: bool = False
+    # Sampling-boundary concurrency (ISSUE 17): N concurrent pullers over
+    # M shards (0 = auto: min(shards, 8); 1 = serial, the control leg) and
+    # one phase of batch prefetch overlapping the compiled learn step
+    # (0 = off — the determinism-anchor default).
+    shard_pullers: int = 0
+    shard_prefetch: int = 0
 
 
 class IngestServer:
@@ -147,6 +161,7 @@ class IngestServer:
         auth_token: Optional[str] = None,
         shards=None,
         expected_actors: Optional[int] = None,
+        shard_assignment_fn: Optional[Callable[[str], Any]] = None,
     ):
         self.queue = staging_queue
         # In-network sampling (fleet/sampler.py, ISSUE 10): when a
@@ -164,6 +179,14 @@ class IngestServer:
         # can never lose step/episode sums).  This handler is agnostic
         # to where replay lives.
         self.shards = shards
+        # Direct data plane (ISSUE 17): when set, every ack on the control
+        # connection carries {"shard", "address", "epoch"} for the actor's
+        # home shard (``assignment_for`` on the RemoteShardSet) so the
+        # actor can dial its shard directly for SEQS; epoch-bumped rejoins
+        # re-advertise through the same ack field.  None (or a fn that
+        # returns None — tier in-learner, shard down, address file not yet
+        # published) means: keep forwarding through this server.
+        self.shard_assignment_fn = shard_assignment_fn
         self._request_address = address
         self.shed_after_s = shed_after_s
         self.startup_shed_grace_s = startup_shed_grace_s
@@ -610,6 +633,36 @@ class IngestServer:
             return version
         return sent_version
 
+    def _assignment(self, actor: str, wait_s: float = 0.0):
+        """The actor's current shard assignment (or None — keep forwarding).
+
+        Guarded: an assignment fn that raises must never cost the control
+        connection.  ``wait_s`` bounds a HELLO-time poll for the shard
+        tier's address file — a fresh fleet races actor HELLOs against
+        the tier's atomic address publish, and waiting ~a second here
+        means the actor's FIRST staged batch already rides the data plane
+        (the bench leg's shard_forward_bytes == 0 depends on it).
+        Steady-state refreshes (SEQS/STATS acks) pass 0: never block the
+        experience path on an address lookup."""
+        if self.shard_assignment_fn is None:
+            return None
+        deadline = time.monotonic() + wait_s
+        while True:
+            try:
+                assignment = self.shard_assignment_fn(actor)
+            except Exception as e:  # noqa: BLE001 - advisory, never fatal
+                flight_event(
+                    "assignment_error",
+                    actor=actor,
+                    error=f"{type(e).__name__}: {e}",
+                )
+                return None
+            if assignment is not None or time.monotonic() >= deadline:
+                return assignment
+            if self._stop.is_set():
+                return None
+            time.sleep(0.1)
+
     def _put_or_shed(self, msg) -> bool:
         """Bounded-wait enqueue: True = queued, False = shed.
 
@@ -722,13 +775,20 @@ class IngestServer:
             # from its first well-formed TELEM (which may never come).
             self._arm_telem_staleness(actor)
             sent_version = self._push_params_if_stale(conn, 0, bytes_out)
+            # Direct data plane (ISSUE 17): the HELLO ack advertises the
+            # actor's shard assignment + dialable address.  Bounded poll:
+            # a fresh tier publishes its address file a beat after the
+            # first HELLOs land, and shipping the assignment NOW means no
+            # forwarded warmup batches.
+            hello_assignment = self._assignment(actor, wait_s=10.0)
+            ack = {"code": OK, "param_version": sent_version}
+            if hello_assignment is not None:
+                ack["shard_assignment"] = hello_assignment
             bytes_out.inc(
                 send_frame(
                     conn,
                     K_ACK,
-                    pack_obj(  # wire-lint: control
-                        {"code": OK, "param_version": sent_version}
-                    ),
+                    pack_obj(ack),  # wire-lint: control
                 )
             )
             streaming = False  # first SEQS tightens the read deadline
@@ -757,6 +817,39 @@ class IngestServer:
                             actor=actor,
                             error=f"{type(e).__name__}: {e}",
                         )
+                    continue
+                if kind == K_STATS:
+                    # Split-plane accounting (ISSUE 17): the staged batch
+                    # went straight to the actor's shard on the data
+                    # plane; this tiny control frame carries ONLY the
+                    # accounting deltas, banked into the same sums the
+                    # forwarded path's ``add`` banks — the actor clears
+                    # its accumulators on THIS ack, so at-least-once
+                    # accounting is plane-independent.
+                    if not streaming:
+                        conn.settimeout(self.read_deadline_s)
+                        streaming = True
+                    stats_msg = unpack_obj(payload)  # wire-lint: control
+                    if self.shards is not None:
+                        self.shards.bank_stats(stats_msg)
+                    self._obs_staleness.labels(actor=actor).set(
+                        self._param_version
+                        - int(stats_msg.get("param_version", 0))
+                    )
+                    sent_version = self._push_params_if_stale(
+                        conn, sent_version, bytes_out
+                    )
+                    ack = {"code": OK, "param_version": sent_version}
+                    assignment = self._assignment(actor)
+                    if assignment is not None:
+                        ack["shard_assignment"] = assignment
+                    bytes_out.inc(
+                        send_frame(
+                            conn,
+                            K_ACK,
+                            pack_obj(ack),  # wire-lint: control
+                        )
+                    )
                     continue
                 if kind != K_SEQS:
                     raise FrameError(f"expected SEQS/BYE, got kind {kind}")
@@ -846,13 +939,18 @@ class IngestServer:
                 sent_version = self._push_params_if_stale(
                     conn, sent_version, bytes_out
                 )
+                ack = {"code": code, "param_version": sent_version}
+                # Assignment refresh on every ack (non-blocking): a
+                # fallen-back actor re-learns its shard's address the
+                # moment an epoch-bumped rejoin re-publishes it.
+                assignment = self._assignment(actor)
+                if assignment is not None:
+                    ack["shard_assignment"] = assignment
                 bytes_out.inc(
                     send_frame(
                         conn,
                         K_ACK,
-                        pack_obj(  # wire-lint: control
-                            {"code": code, "param_version": sent_version}
-                        ),
+                        pack_obj(ack),  # wire-lint: control
                     )
                 )
         except PeerDeadError as e:

@@ -198,10 +198,16 @@ class ShardSet:
         deltas enter the bank.  Never sheds: a full ring FIFO-evicts."""
         staged: StagedSequences = msg["staged"]
         n = self.shards[shard_id].add(staged.seq, staged.priorities)
+        self.bank_stats(msg)
+        return n
+
+    def bank_stats(self, msg: Dict[str, Any]) -> None:
+        """Bank one message's accounting deltas (the K_STATS control
+        frame's landing spot on the split-plane wire, ISSUE 17 — same
+        bank ``add`` feeds on the forwarded path)."""
         with self._stats_lock:
             for k in self._stats:
                 self._stats[k] += float(msg.get(k, 0.0))
-        return n
 
     def pop_stats(self) -> Dict[str, float]:
         with self._stats_lock:
@@ -218,6 +224,39 @@ class ShardSet:
 
     def evictions_total(self) -> int:
         return sum(s.evictions_total for s in self.shards)
+
+
+class _PrefetchPull:
+    """One background pull (``--shard-prefetch 1``): phase ``p+1``'s
+    two-level draw/encode/transit overlaps phase ``p``'s compiled learn
+    step, the way the pipelined executor overlaps collect.  Exactly one
+    pull is ever in flight (kicked only after the previous completed),
+    so the learner's np_rng stays a sequentially-consumed stream — same
+    draws as the unprefetched schedule.  Daemon thread: a pull stuck on
+    a dead tier must never pin process exit."""
+
+    def __init__(self, fn: Callable[[], Any]):
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(fn,), name="sampler-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self, fn) -> None:
+        try:
+            self._result = fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised at result()
+            self._error = e
+        finally:
+            self._done.set()
+
+    def result(self) -> Any:
+        self._done.wait()
+        if self._error is not None:
+            raise self._error
+        return self._result
 
 
 class SamplerLearner:
@@ -303,6 +342,24 @@ class SamplerLearner:
                 alpha=trainer.config.priority_alpha,
                 prioritized=trainer.config.prioritized,
             )
+        # Direct data plane (ISSUE 17): with a standalone tier, the
+        # ingest acks advertise each actor's shard assignment + address
+        # so actors ship SEQS straight to their shard; in-learner shards
+        # have no dialable address — the fn stays None and actors keep
+        # forwarding (the documented fallback).
+        assignment_fn = None
+        if config.shard_direct and self._remote:
+            assignment_fn = self.shards.assignment_for
+        # Sampling-boundary concurrency (ISSUE 17): N pullers over M
+        # shards, one in-flight SAMPLE_REQ per live shard per quota
+        # round.  0 = auto (min(shards, 8)); 1 = the serial control leg.
+        if config.shard_pullers < 0:
+            raise ValueError("shard_pullers must be >= 0")
+        self._pullers = (
+            int(config.shard_pullers)
+            if config.shard_pullers > 0
+            else min(num_shards, 8)
+        )
         # The ingest server routes SEQS straight into the shards; its
         # staging queue exists only structurally (nothing ever enqueues,
         # so nothing can shed — ring eviction is the backpressure).
@@ -319,6 +376,7 @@ class SamplerLearner:
             auth_token=config.auth_token,
             shards=self.shards,
             expected_actors=config.num_actors,
+            shard_assignment_fn=assignment_fn,
         )
         # Loopback frame codecs, one packer/unpacker pair per direction
         # (the sampler loop is the only caller — single-threaded).  The
@@ -378,6 +436,12 @@ class SamplerLearner:
             "r2d2dpg_sampler_sample_seconds",
             "one phase's SAMPLE_REQ -> stacked-batch assembly (pack, "
             "shard draws, decode, stack)",
+        )
+        self.puller_wait = reg.histogram(
+            "r2d2dpg_sampler_puller_wait_seconds",
+            "one puller's SAMPLE_REQ -> BATCH exchange wall time, one "
+            "sample per per-shard draw (N concurrent pullers overlap "
+            "these; the serial control leg sums them)",
         )
         self._obs_trained = reg.counter(
             "r2d2dpg_sampler_trained_seqs_total",
@@ -594,6 +658,14 @@ class SamplerLearner:
                 stall_t0 = None
             quotas = shard_quotas(sums, remaining, rng)
             remaining = 0
+            # Concurrent pullers (ISSUE 17): one quota round = one job
+            # per non-empty shard, req_ids assigned in SHARD-ID ORDER
+            # BEFORE any exchange dispatches and results processed in
+            # shard-id order after the join — the learner rng is consumed
+            # only by shard_quotas above and the final permutation, so
+            # arrival order cannot reach any seeded draw (the puller
+            # determinism pin, tests/test_shard_direct.py).
+            jobs: List[tuple] = []  # (shard_id, quota, req_id, req_tr)
             for shard_id, quota in enumerate(quotas):
                 if quota == 0:
                     continue
@@ -608,18 +680,18 @@ class SamplerLearner:
                     req_tr = obs_trace.TraceStamp(
                         trace_id=tr.trace_id, t_collect_start=time.time()
                     )
-                try:
-                    resp = shards.shards[shard_id].sample(
-                        int(quota), self._req_id, trace=req_tr
-                    )
-                except ShardUnavailableError as e:
+                jobs.append((shard_id, int(quota), self._req_id, req_tr))
+            for (shard_id, quota, _, _), outcome in zip(
+                jobs, self._exchange_jobs(shards, jobs)
+            ):
+                if isinstance(outcome, ShardUnavailableError):
                     # The mid-phase degradation moment: the dead shard's
                     # draws go back into the pool; the NEXT loop
                     # iteration's quota draw sees its weight zeroed
                     # (``_mark_dead`` records the renormalization) — the
                     # phase still delivers its full n_draws, from the
                     # survivors.
-                    shards._mark_dead(shard_id, str(e))
+                    shards._mark_dead(shard_id, str(outcome))
                     flight_event(
                         "shard_draws_redistributed",
                         shard=shard_id,
@@ -627,6 +699,7 @@ class SamplerLearner:
                     )
                     remaining += int(quota)
                     continue
+                resp = outcome
                 if resp is None:
                     # LIVE but empty (a stale quota weight met a freshly
                     # restarted ring): not a death — the ack's advert
@@ -661,6 +734,62 @@ class SamplerLearner:
             self.shards.occupancy_total(),
         )
 
+    def _exchange_jobs(self, shards, jobs: List[tuple]) -> List[Any]:
+        """Run one quota round's SAMPLE_REQ/BATCH exchanges — results in
+        JOB ORDER regardless of arrival order.
+
+        ``--shard-pullers 1`` (the serial control leg) runs them inline,
+        exactly the pre-ISSUE-17 loop; otherwise up to ``self._pullers``
+        exchanges are in flight at once, one per shard (each RemoteShard
+        owns its own socket + leg lock, so per-shard exchanges never
+        contend).  A dead shard's ``ShardUnavailableError`` is an OUTCOME
+        (the caller redistributes its quota); anything else re-raises on
+        the caller's thread.  Every exchange lands one sample in the
+        puller-wait histogram — the overlap this buys is the gap between
+        its sum and the phase's assemble time."""
+        from r2d2dpg_tpu.fleet.shard import ShardUnavailableError
+
+        def one(shard_id: int, quota: int, req_id: int, req_tr) -> Any:
+            t0 = time.monotonic()
+            try:
+                return shards.shards[shard_id].sample(
+                    quota, req_id, trace=req_tr
+                )
+            except ShardUnavailableError as e:
+                return e
+            finally:
+                self.puller_wait.add(time.monotonic() - t0)
+
+        if self._pullers <= 1 or len(jobs) <= 1:
+            return [one(*job) for job in jobs]
+        results: List[Any] = [None] * len(jobs)
+        errors: List[BaseException] = []
+        sem = threading.BoundedSemaphore(self._pullers)
+
+        def work(i: int, job: tuple) -> None:
+            with sem:
+                try:
+                    results[i] = one(*job)
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    errors.append(e)
+
+        threads = [
+            threading.Thread(
+                target=work,
+                args=(i, job),
+                name=f"sampler-puller-{job[0]}",
+                daemon=True,
+            )
+            for i, job in enumerate(jobs)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return results
+
     def _write_back_remote(self, handles, prios: np.ndarray) -> None:
         """TD write-back to standalone shards, grouped per (shard, epoch):
         a shard that died since the sample drops its verdict loudly
@@ -693,9 +822,16 @@ class SamplerLearner:
                         entries=int(m.sum()),
                     )
                     continue
+                # Coalesced write-back (ISSUE 17): with-replacement draws
+                # repeat (slot, gen) keys within a phase — dedupe to the
+                # LAST write (sequential application is last-write-wins)
+                # so one (shard, epoch) PRIO frame carries each key once.
+                c_slots, c_gens, c_prios = wire.coalesce_prio_update(
+                    slots[m], gens[m], prios[m]
+                )
                 try:
                     sh.write_back(
-                        slots[m], gens[m], prios[m], epoch=int(ep)
+                        c_slots, c_gens, c_prios, epoch=int(ep)
                     )
                 except ShardUnavailableError as e:
                     self.shards._mark_dead(int(shard_id), str(e))
@@ -714,15 +850,20 @@ class SamplerLearner:
         prios = np.asarray(prios, np.float32).reshape(-1)
         for shard_id in np.unique(shard_of):
             m = shard_of == shard_id
+            # Same coalesce as the remote path: one PRIO frame per shard
+            # per phase, each (slot, gen) key once (last write wins).
+            c_slots, c_gens, c_prios = wire.coalesce_prio_update(
+                slots[m], gens[m], prios[m]
+            )
             upd = wire.unpack_prio_update(
                 self._roundtrip(
                     self._req_unpacker,
                     wire.pack_prio_update(
                         self._req_packer,
                         shard=int(shard_id),
-                        slots=slots[m],
-                        gens=gens[m],
-                        priorities=prios[m],
+                        slots=c_slots,
+                        gens=c_gens,
+                        priorities=c_prios,
                     ),
                 )
             )
@@ -778,6 +919,7 @@ class SamplerLearner:
         self.sampler_wait.reset()
         self.sampler_absorb.reset()
         self.sample_assemble.reset()
+        self.puller_wait.reset()
         resume_from = resume_from or {}
         version = int(resume_from.get("param_version", 0)) + 1
         self.server.publish_params(version, self._snapshot_params(train))
@@ -818,9 +960,22 @@ class SamplerLearner:
             last_growth = time.monotonic()
             last_occ = -1
             t_wait = time.monotonic()
+            # Direct data plane (ISSUE 17): SEQS bypass the learner, so
+            # no forward ack refreshes the occupancy view — poke the
+            # shards' adverts over the sampler leg or the gate would
+            # starve against a tier the actors are actively filling.
+            poke_adverts = (
+                bool(self.config.shard_direct)
+                and self._remote
+                and hasattr(self.shards, "refresh_adverts")
+            )
+            last_poke = 0.0
             while self.shards.occupancy_total() < cfg.min_replay:
                 if deadline is not None and time.monotonic() >= deadline:
                     break
+                if poke_adverts and time.monotonic() - last_poke >= 0.25:
+                    self.shards.refresh_adverts()
+                    last_poke = time.monotonic()
                 occ = self.shards.occupancy_total()
                 if occ != last_occ:
                     last_occ = occ
@@ -837,24 +992,56 @@ class SamplerLearner:
                 time.sleep(0.05)
             self.sampler_absorb.add(time.monotonic() - t_wait)
 
+            # Batch prefetch (ISSUE 17, --shard-prefetch 1): pull phase
+            # p+1 on a background thread while phase p learns.  The
+            # np_rng stays sequential (one pull in flight, ever) so the
+            # DRAWS are anchor-identical; what moves by one phase is the
+            # write-back visibility — phase p+1 samples against
+            # priorities that do not yet reflect phase p's TD verdict
+            # (stale-by-one, the documented overlap tradeoff, docs/
+            # REPLAY.md "Direct data plane").  0 (default) keeps the
+            # strict pull->learn->write-back interleave.
+            prefetch_on = bool(self.config.shard_prefetch) and self._remote
+            pending: Optional[_PrefetchPull] = None
+
+            def pull_once() -> Dict[str, Any]:
+                tr = obs_trace.maybe_start(trace_sample)
+                t_req = time.time()
+                t_assemble = time.monotonic()
+                out = self._pull_phase_batches(n_draws, np_rng, tr)
+                return {
+                    "out": out,
+                    "tr": tr,
+                    "t_req": t_req,
+                    "assemble_s": time.monotonic() - t_assemble,
+                    "stall_s": self._phase_stall_s,
+                }
+
             while drained < num_train_phases:
                 if deadline is not None and time.monotonic() >= deadline:
                     break
                 fold_stats()
                 mon.on_phase(drained + 1)
-                tr = obs_trace.maybe_start(trace_sample)
-                t_req = time.time()
-                t_assemble = time.monotonic()
-                seq_np, probs_np, handles, occ = self._pull_phase_batches(
-                    n_draws, np_rng, tr
-                )
+                if pending is not None:
+                    pulled, pending = pending.result(), None
+                else:
+                    pulled = pull_once()
+                if (
+                    prefetch_on
+                    and drained + 1 < num_train_phases
+                    and (deadline is None or time.monotonic() < deadline)
+                ):
+                    pending = _PrefetchPull(pull_once)
+                tr = pulled["tr"]
+                t_req = pulled["t_req"]
+                seq_np, probs_np, handles, occ = pulled["out"]
                 t_batches = time.time()
-                self.sample_assemble.add(time.monotonic() - t_assemble)
+                self.sample_assemble.add(pulled["assemble_s"])
                 # One wait sample per PHASE, zeros included (see the
                 # _pull_phase_batches docstring): stall-free phases
                 # dilute and eventually evict a past outage's sample, so
                 # the /health p99 answers "starving NOW", not "ever".
-                self.sampler_wait.add(self._phase_stall_s)
+                self.sampler_wait.add(pulled["stall_s"])
                 # [n] -> [K, B] for the compiled K-update scan, then
                 # mesh placement through the _put_staged hook on the
                 # BATCH axis (axis=1): under --learner-dp each dp slice
@@ -990,6 +1177,7 @@ class SamplerLearner:
             wall = max(t_end - t0, 1e-9)
             _, sw_total, sw_p50, sw_p99 = self.sampler_wait.snapshot()
             _, sa_total, _, _ = self.sampler_absorb.snapshot()
+            _, pw_total, _, pw_p99 = self.puller_wait.snapshot()
             srv = self.server
             drained_here = drained - drained_at_start
             trained = drained_here * n_draws
@@ -1034,6 +1222,12 @@ class SamplerLearner:
                 "sampler_wait_p99_ms": sw_p99 * 1e3,
                 "sampler_wait_total_s": sw_total,
                 "sampler_absorb_s": sa_total,
+                # Puller concurrency (ISSUE 17): per-exchange wall times;
+                # with N pullers the phase pays ~the max, the serial
+                # control leg pays the sum.
+                "shard_pullers": float(self._pullers if self._remote else 1),
+                "puller_wait_p99_ms": pw_p99 * 1e3,
+                "puller_wait_total_s": pw_total,
                 # The pipelined executor's overlap instrumentation,
                 # riding the composed loop (ISSUE 11): fraction of the
                 # wall during which the learner had sample data available

@@ -240,6 +240,22 @@ class ShardServer:
             "deadline (the peer answered neither frames nor the PING)",
             labelnames=("shard",),
         ).labels(shard=sid)
+        # Direct data plane (ISSUE 17): bytes on connections whose HELLO
+        # declared plane="data" (actors shipping SEQS straight to this
+        # shard).  A separate metric family from the control-plane
+        # r2d2dpg_fleet_bytes_* and the sampling-boundary totals — the
+        # PR 13 TELEM double-count lesson, pinned by test.  The
+        # r2d2dpg_fleet_ prefix keeps these out of the TELEM echo.
+        self._obs_data_in = reg.counter(
+            "r2d2dpg_fleet_data_bytes_in_total",
+            "bytes received on direct data-plane connections",
+            labelnames=("plane",),
+        )
+        self._obs_data_out = reg.counter(
+            "r2d2dpg_fleet_data_bytes_out_total",
+            "bytes sent on direct data-plane connections",
+            labelnames=("plane",),
+        )
         # The ring internals, registered where the ring LIVES (set_fn:
         # live at snapshot time, so each TELEM push carries the instant's
         # truth, not a reply-paced copy).  Same names as the learner-side
@@ -464,6 +480,15 @@ class ShardServer:
                         ),
                     )
                     return
+            # Per-plane byte accounting (ISSUE 17): a no-op on the
+            # learner's ingest/sample legs; an actor's direct SEQS leg
+            # declares plane="data" at HELLO and its bytes land ONLY in
+            # the data-plane counters.
+            count_in = count_out = lambda n: None  # noqa: E731
+            if str(hello.get("plane", "")) == "data":
+                count_in = self._obs_data_in.labels(plane="data").inc
+                count_out = self._obs_data_out.labels(plane="data").inc
+                count_in(HEADER_BYTES + len(payload))
             mismatch = wire.check_negotiation(hello, self.wire_config)
             if mismatch is not None:
                 flight_event(
@@ -481,18 +506,24 @@ class ShardServer:
                     ),
                 )
                 return
-            send_frame(
-                conn,
-                K_ACK,
-                pack_obj(self._advert()),  # wire-lint: control
+            count_out(
+                send_frame(
+                    conn,
+                    K_ACK,
+                    pack_obj(self._advert()),  # wire-lint: control
+                )
             )
             # Staleness is armed learner-side at HELLO; the forced push
             # means the gauge arms WITH data, not against silence.
             self._maybe_send_telem(conn, force=True)
             while not self._stop.is_set():
                 kind, payload = recv_frame_heartbeat(
-                    conn, max_frame_bytes=self.max_frame_bytes
+                    conn,
+                    max_frame_bytes=self.max_frame_bytes,
+                    bytes_in=count_in,
+                    bytes_out=count_out,
                 )
+                count_in(HEADER_BYTES + len(payload))
                 if kind == K_BYE:
                     return
                 if kind == K_SEQS:
@@ -505,10 +536,12 @@ class ShardServer:
                         # the arming frame's OWN ack is already stalled.
                         self.chaos.on_seqs_frame()
                     self._gate()
-                    send_frame(
-                        conn,
-                        K_ACK,
-                        pack_obj(self._advert()),  # wire-lint: control
+                    count_out(
+                        send_frame(
+                            conn,
+                            K_ACK,
+                            pack_obj(self._advert()),  # wire-lint: control
+                        )
                     )
                     self._maybe_send_telem(conn)
                 elif kind == K_SAMPLE_REQ:
@@ -526,6 +559,24 @@ class ShardServer:
                             f"SAMPLE_REQ for shard {req['shard']} on shard "
                             f"{self.shard.shard_id}'s socket"
                         )
+                    if int(req["quota"]) <= 0:
+                        # Advert poke (ISSUE 17): under the direct data
+                        # plane no SEQS forwards ride the learner's
+                        # ingest leg, so no ack refreshes its occupancy/
+                        # quota view — the absorb gate polls with
+                        # zero-quota REQs instead.  Answer with a bare
+                        # advert ack: no draw, no rng touch (the draw
+                        # stream stays anchor-identical).
+                        self._gate()
+                        send_frame(
+                            conn,
+                            K_ACK,
+                            pack_obj(  # wire-lint: control
+                                {**self._advert(), "poke": True}
+                            ),
+                        )
+                        self._maybe_send_telem(conn)
+                        continue
                     try:
                         s = self.shard.sample(req["quota"], self._rng)
                     except ValueError:
@@ -983,6 +1034,41 @@ class RemoteShard:
 
         return self._exchange("sample", do)
 
+    def refresh_advert(self) -> Dict[str, Any]:
+        """Sampler leg: one zero-quota SAMPLE_REQ whose only purpose is
+        the advert riding the ack.  The direct data plane (ISSUE 17)
+        bypasses the learner's ingest leg entirely, so no SEQS ack
+        refreshes the learner-side occupancy/quota view — the absorb
+        gate polls it with this exchange instead (no draw shard-side,
+        so the sampling rng stream is untouched)."""
+
+        def do(sock, packer, unpacker):
+            n = send_frame_parts(
+                sock,
+                K_SAMPLE_REQ,
+                wire.pack_sample_req(
+                    packer,
+                    req_id=0,
+                    shard=self.shard_id,
+                    quota=0,
+                    trace=None,
+                ),
+                max_frame_bytes=self.max_frame_bytes,
+            )
+            self._on_bytes("sample", n)
+            kind, payload = self._recv("sample", sock)
+            self._on_bytes("sample", HEADER_BYTES + len(payload))
+            if kind != K_ACK:
+                raise FrameError(
+                    f"expected ACK to zero-quota SAMPLE_REQ, got kind {kind}"
+                )
+            ack = unpack_obj(payload)  # wire-lint: control
+            self._apply_advert(ack)
+            self.epoch = int(ack.get("epoch", self.epoch))
+            return ack
+
+        return self._exchange("sample", do)
+
     def write_back(
         self,
         slots: np.ndarray,
@@ -1137,6 +1223,11 @@ class RemoteShardSet:
             "(re-collectable experience recycled before it was sampled)",
             labelnames=("shard",),
         )
+        # Kept for the direct data plane's assignment acks (ISSUE 17):
+        # ``assignment_for`` re-reads the published address per ack so an
+        # epoch-bumped rejoin's fresh address reaches actors without any
+        # new coordination channel.
+        self._address_fn = address_fn
         self.shards = [
             RemoteShard(
                 i,
@@ -1397,6 +1488,34 @@ class RemoteShardSet:
                 return sid
         return home  # all dead: add() waits for a rejoin
 
+    def assignment_for(self, actor_id: Any) -> Optional[Dict[str, Any]]:
+        """The direct data plane's assignment-ack payload (ISSUE 17):
+        the actor's routed shard + its dialable address + the epoch the
+        learner last HELLO'd it at — or None when the shard has no
+        published address yet or is marked dead (the actor keeps
+        forwarding through the learner).  The epoch is advisory: the
+        actor's OWN data-plane HELLO ack is the authoritative fence."""
+        sid = self.route(actor_id)
+        s = self.shards[sid]
+        if not s.alive:
+            return None
+        try:
+            addr = self._address_fn(sid)
+        except Exception:  # noqa: BLE001 - advisory path, never fatal
+            return None
+        if addr is None:
+            return None
+        return {"shard": sid, "address": addr, "epoch": s.epoch}
+
+    def bank_stats(self, msg: Dict[str, Any]) -> None:
+        """Bank one message's accounting deltas learner-side — the
+        at-least-once half of every ingest path: the forwarded path banks
+        inside ``add``; the split-plane path banks from the K_STATS
+        control frame while the experience rides the data plane."""
+        with self._stats_lock:
+            for k in self._stats:
+                self._stats[k] += float(msg.get(k, 0.0))
+
     def add(self, shard_id: int, msg: Dict[str, Any]) -> int:
         """One SEQS message into the tier (ingest-handler side): bank the
         accounting deltas FIRST (they must survive any shard outcome),
@@ -1405,9 +1524,7 @@ class RemoteShardSet:
         ack wait is the backpressure) until stop.  Returns B."""
         staged: StagedSequences = msg["staged"]
         n = int(np.shape(staged.seq.reward)[0])
-        with self._stats_lock:
-            for k in self._stats:
-                self._stats[k] += float(msg.get(k, 0.0))
+        self.bank_stats(msg)
         target = int(shard_id)
         while not self._stop.is_set():
             if not self.shards[target].alive:
@@ -1440,6 +1557,29 @@ class RemoteShardSet:
             for k in self._stats:
                 self._stats[k] = 0.0
         return out
+
+    def refresh_adverts(self) -> int:
+        """Zero-quota advert poke across the live shards (ISSUE 17):
+        with the direct data plane the actors' SEQS never cross the
+        learner, so the occupancy/quota view that used to refresh on
+        forward acks would stay frozen at zero and the absorb gate
+        would starve against a filling tier.  Not-up-yet shards are
+        waited out exactly like ``add`` does (a spurious death verdict
+        at startup would poison the recovery metrics); an unreachable
+        previously-connected shard is marked dead here — the poke is
+        the learner's only contact during absorb, so this IS the death
+        detector for that window.  Returns how many adverts refreshed."""
+        refreshed = 0
+        for s in self.shards:
+            if not s.alive:
+                continue
+            try:
+                s.refresh_advert()
+                refreshed += 1
+            except ShardUnavailableError as e:
+                if not e.not_up:
+                    self._mark_dead(s.shard_id, str(e))
+        return refreshed
 
     def occupancy_total(self) -> int:
         return sum(s.occupancy for s in self.shards if s.alive)

@@ -80,6 +80,11 @@ K_PONG = 8  # either side: liveness answer (any frame also proves liveness)
 K_SAMPLE_REQ = 9  # learner -> shard: {"req_id", "shard", "quota"}
 K_BATCH = 10  # shard -> learner: sampled sequences + slots/gens/probs + sums
 K_PRIO = 11  # learner -> shard: TD priority write-back keyed slot/generation
+# Split-plane wire (ISSUE 17): when the actor ships SEQS directly to its
+# shard, the accounting deltas still ride the learner control connection
+# as a tiny pickled frame — banked learner-side, cleared only on ack, so
+# at-least-once accounting is plane-independent.
+K_STATS = 12  # actor -> ingest: accounting deltas only (no staged payload)
 
 # 256 MiB default ceiling: a humanoid-shaped staged batch (256 envs x seq
 # 85) is ~20 MiB, so this bounds corruption blast radius without touching

@@ -670,6 +670,36 @@ def pack_prio_update(
     )
 
 
+def coalesce_prio_update(
+    slots: np.ndarray, gens: np.ndarray, priorities: np.ndarray
+):
+    """Coalesce one phase's write-back handles for a single (shard,
+    epoch) PRIO frame (ISSUE 17): with-replacement draws repeat (slot,
+    generation) keys, and applying those duplicates sequentially is
+    last-write-wins — so only each key's LAST priority needs to cross
+    the sampling boundary.  Surviving entries keep their original
+    relative order (deterministic: a pure function of the input order),
+    and the shard-side result is bit-identical to applying the
+    uncoalesced stream.  Returns ``(slots, gens, priorities)`` as
+    contiguous int64/int64/float32 arrays."""
+    slots = np.ascontiguousarray(slots, np.int64).reshape(-1)
+    gens = np.ascontiguousarray(gens, np.int64).reshape(-1)
+    priorities = np.ascontiguousarray(priorities, np.float32).reshape(-1)
+    if not (slots.shape == gens.shape == priorities.shape):
+        raise WireFormatError(
+            "coalesce: slots/gens/priorities length mismatch"
+        )
+    if slots.size <= 1:
+        return slots, gens, priorities
+    # Last occurrence per (slot, gen): unique over the REVERSED key rows
+    # keeps each key's first-seen index there, i.e. its last-seen index
+    # here; re-sorting the kept indices restores input order.
+    keys = np.stack([slots, gens], axis=1)
+    _, rev_idx = np.unique(keys[::-1], axis=0, return_index=True)
+    keep = np.sort(slots.size - 1 - rev_idx)
+    return slots[keep], gens[keep], priorities[keep]
+
+
 def unpack_prio_update(obj: Any) -> Dict[str, Any]:
     if not (
         isinstance(obj, dict)

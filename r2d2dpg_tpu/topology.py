@@ -100,6 +100,12 @@ class Topology:
     # shard processes (fleet/shard.py) — a deployment refinement of the
     # sharded_rings/two_level stages, not a new stage.
     shard_procs: int = 0
+    # How actor SEQS traffic REACHES the shards (ISSUE 17): False = the
+    # learner-forwarded path (ingest handlers forward — the pinned
+    # off-setting), True = actors dial their assigned shard directly and
+    # the control connection carries only params/telem/accounting.  A
+    # wire-plane refinement of the sharded_rings stage, not a new stage.
+    shard_direct: bool = False
 
     def describe(self) -> str:
         return (
@@ -108,6 +114,7 @@ class Topology:
             f"schedule={self.schedule} actors={self.actors} "
             f"replay_shards={self.replay_shards} "
             f"shard_procs={self.shard_procs} "
+            f"shard_direct={int(self.shard_direct)} "
             f"learner_dp={self.learner_dp} spmd={self.spmd}"
         )
 
@@ -152,6 +159,7 @@ def resolve(args) -> Topology:
         spmd=int(args.spmd or 0),
         pipeline=bool(args.pipeline),
         shard_procs=int(getattr(args, "shard_procs", 0) or 0),
+        shard_direct=bool(getattr(args, "shard_direct", 0)),
     )
 
 
@@ -217,6 +225,22 @@ def _chaos_shard_faults(a) -> bool:
 
     return any(
         f.kind in SHARD_FAULTS for f in parse_chaos_spec(a.chaos_spec)
+    )
+
+
+def _chaos_direct_faults(a) -> bool:
+    if not a.chaos_spec or getattr(a, "shard_direct", 0):
+        return False
+    from r2d2dpg_tpu.fleet.chaos import DIRECT_FAULTS, parse_chaos_spec
+
+    return any(
+        f.kind in DIRECT_FAULTS for f in parse_chaos_spec(a.chaos_spec)
+    )
+
+
+def _sampler_pull_knobs(a) -> bool:
+    return bool(
+        getattr(a, "shard_pullers", 0) or getattr(a, "shard_prefetch", 0)
     )
 
 
@@ -418,6 +442,52 @@ REFUSALS: Tuple[Refusal, ...] = (
         argv=("--actors", "2", "--replay-shards", "2",
               "--chaos-spec", "kill_shard@p2"),
     ),
+    # ---------------------------------------------- direct data plane
+    Refusal(
+        key="shard-direct-without-sampler-path",
+        when=lambda a, np: bool(
+            getattr(a, "shard_direct", 0)
+            and not (a.actors and a.replay_shards)
+        ),
+        reason=(
+            "--shard-direct 1 requires --actors N --replay-shards M: the "
+            "direct data plane routes actor SEQS traffic to the sampler "
+            "path's replay shards (--shard-direct 0 is the "
+            "learner-forwarded path — the pinned off-setting; "
+            "docs/TOPOLOGY.md)"
+        ),
+        match="requires --actors",
+        argv=("--shard-direct", "1"),
+    ),
+    Refusal(
+        key="sampler-knobs-without-shards",
+        when=lambda a, np: bool(
+            not a.replay_shards and _sampler_pull_knobs(a)
+        ),
+        reason=(
+            "--shard-pullers/--shard-prefetch require --replay-shards N: "
+            "the concurrent pullers and the batch prefetch belong to the "
+            "sampler learner's pull loop, which the central-drain and "
+            "in-process schedules do not run (docs/TOPOLOGY.md)"
+        ),
+        match="require --replay-shards",
+        argv=("--shard-pullers", "2"),
+    ),
+    Refusal(
+        key="data-plane-chaos-without-shard-direct",
+        when=lambda a, np: _chaos_direct_faults(a),
+        reason=(
+            "--chaos-spec partition_data_plane drills the direct "
+            "actor->shard data leg and requires --shard-direct 1: with "
+            "the experience riding the learner-forwarded path there is "
+            "no data plane to partition, so the drill would record "
+            "evidence for a recovery path that never ran "
+            "(docs/TOPOLOGY.md)"
+        ),
+        match="shard-direct",
+        argv=("--actors", "2", "--replay-shards", "2",
+              "--chaos-spec", "partition_data_plane@p2"),
+    ),
     # ------------------------------------------------------- dp learner
     Refusal(
         key="learner-dp-x-spmd",
@@ -537,6 +607,13 @@ def validate(args, process_count: int = 1) -> Topology:
             f"divisible by {shard_procs} shard processes (contiguous "
             f"equal slices per process)"
         )
+    if int(getattr(args, "shard_pullers", 0) or 0) < 0:
+        raise SystemExit(
+            "--shard-pullers must be >= 0 (0 = one puller per shard, "
+            "capped at 8)"
+        )
+    if int(getattr(args, "shard_prefetch", 0) or 0) < 0:
+        raise SystemExit("--shard-prefetch must be >= 0 (0 = off)")
     if args.learner_dp and args.learner_dp < 1:
         raise SystemExit("--learner-dp must be >= 1 (0 = off)")
     if getattr(args, "autoscale", 0):

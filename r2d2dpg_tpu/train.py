@@ -153,6 +153,39 @@ def parse_args(argv=None) -> argparse.Namespace:
         "under a bumped epoch that fences stale BATCH/PRIO traffic.  "
         "0 = in-learner loopback (PR 10's path, pinned bit-identical)"
     )
+    # Direct data plane + concurrent pullers (ISSUE 17; docs/REPLAY.md
+    # "Direct data plane").
+    p.add_argument(
+        "--shard-direct", type=int, default=0, choices=[0, 1],
+        help="1: the ingest ack advertises each actor's shard assignment "
+        "(consistent-hash shard + its dialable address + epoch) and the "
+        "actor ships SEQS straight to the shard — the learner wire "
+        "carries only params/telem/accounting (a tiny K_STATS frame per "
+        "phase), shedding the ingest forward hop from the experience "
+        "path.  Requires --actors N --replay-shards M; with "
+        "--shard-procs 0 there is no dialable tier, so actors stay on "
+        "the learner-forwarded path (the documented fallback, also "
+        "taken loudly on any data-leg failure).  0 = learner-forwarded "
+        "(pinned bit-identical)"
+    )
+    p.add_argument(
+        "--shard-pullers", type=int, default=0, metavar="N",
+        help="concurrent SAMPLE_REQ pullers over the replay shards "
+        "(fleet/sampler.py): each quota round keeps one in-flight "
+        "request per live shard, up to N at once — draw quotas and "
+        "req-id assignment stay in shard-id order, so the pulled batch "
+        "is bit-identical to the serial loop regardless of arrival "
+        "order.  0 = one puller per shard, capped at 8; 1 = the serial "
+        "loop"
+    )
+    p.add_argument(
+        "--shard-prefetch", type=int, default=0, choices=[0, 1],
+        help="1: overlap one phase of batch prefetch with training — the "
+        "next phase's pull starts while the current batch trains "
+        "(priorities it samples under are stale by exactly the one "
+        "phase in flight, the documented Reverb-style tradeoff).  "
+        "0 = off (pull inline; pinned bit-identical)"
+    )
     # Fleet fault tolerance (docs/FLEET.md "Failure modes & recovery").
     p.add_argument(
         "--fleet-heartbeat", type=float, default=None, metavar="S",
@@ -1065,6 +1098,9 @@ def _run_fleet(
         drain_coalesce=args.drain_coalesce,
         heartbeat_s=heartbeat_s,
         auth_token=fleet_token,
+        shard_direct=bool(args.shard_direct),
+        shard_pullers=args.shard_pullers,
+        shard_prefetch=args.shard_prefetch,
     )
     # The ingest+sample+learn assembly comes from the validated Topology
     # (docs/TOPOLOGY.md): sharded rings + two-level sampling ->
@@ -1195,6 +1231,10 @@ def _run_fleet(
         extra += ["--trace-sample", str(args.trace_sample)]
     # Liveness: one deadline per fleet, both wire ends (docs/FLEET.md).
     extra += ["--read-deadline", str(heartbeat_s)]
+    if args.shard_direct:
+        # The direct data plane (ISSUE 17): actors dial the shard the
+        # ingest ack advertises and ship SEQS to it directly.
+        extra += ["--shard-direct", "1"]
     if args.chaos_spec:
         # Actors fire the stall/corrupt faults that target their id; the
         # learner's engine fires the rest — same seeded schedule.

@@ -455,21 +455,38 @@ shard_scrape_check() {
 # observability-dark (its TELEM never folded, its advert mirror never
 # registered) must not be blessed as evidence, because the numbers it
 # contributed cannot be attributed on the one fleet /metrics page.
+#
+# ISSUE 17 adds the direct-data-plane clause: a run trained with
+# --shard-direct 1 (actors pushing SEQS straight to shard procs,
+# learner forward hop shed) may only be blessed if BOTH the
+# -m shard_direct suite (assignment acks, K_STATS at-least-once
+# accounting, per-plane byte separation, puller bit-determinism,
+# coalesced PRIO golden) AND the partition_data_plane fallback drill
+# (chaos e2e: dial refused mid-run -> loud fallback to the forwarded
+# path, zero lost accounting) pass on this checkout, alongside the
+# --shard-direct 0 bitwise CLI anchor that the 'determinism' -k
+# selection already carries.  Direct evidence WITHOUT a passing
+# fallback drill is refused outright: a data plane that has never
+# demonstrated its escape hatch cannot be blessed.  The resolved flag
+# is stamped (shard_direct.txt beside shard_procs.txt) so a blessed
+# number always says which experience path produced it.
 #   shard_gate <dir> <train args...>
 shard_gate() {
   local dir=$1
   shift
-  local _sp="" _rs="" _sp_prev=""
+  local _sp="" _rs="" _sd="" _sp_prev=""
   local _sp_arg
   for _sp_arg in "$@"; do
     # Both argparse spellings: "--flag value" and "--flag=value".
     case "$_sp_arg" in
       --shard-procs=*) _sp=${_sp_arg#*=} ;;
       --replay-shards=*) _rs=${_sp_arg#*=} ;;
+      --shard-direct=*) _sd=${_sp_arg#*=} ;;
     esac
     case "$_sp_prev" in
       --shard-procs) _sp=$_sp_arg ;;
       --replay-shards) _rs=$_sp_arg ;;
+      --shard-direct) _sd=$_sp_arg ;;
     esac
     _sp_prev=$_sp_arg
   done
@@ -477,8 +494,29 @@ shard_gate() {
     return 0  # in-learner loopback (or no sampler path): nothing to gate
   fi
   printf 'shard_procs=%s\n' "$_sp" > "$dir/shard_procs.txt"
+  printf 'shard_direct=%s\n' "${_sd:-0}" > "$dir/shard_direct.txt"
   if ! shard_scrape_check "$dir" "${_rs:-$_sp}"; then
     return 1
+  fi
+  if [ -n "$_sd" ] && [ "$_sd" != 0 ] \
+     && ! [ -f "$dir/.shard_direct_ok" ]; then
+    # Fallback drill + direct-plane suite, refused-not-skipped: every
+    # test in the file carries the shard_direct mark, so -m shard_direct
+    # deliberately includes the slow e2e pair (direct run + the
+    # partition_data_plane fallback drill) — the drill is the point.
+    if ! timeout --kill-after=30 900 \
+         env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+         R2D2DPG_PALLAS_INTERPRET=1 XLA_FLAGS= \
+         python -m pytest tests/test_shard_direct.py \
+           -q -p no:cacheprovider -m shard_direct \
+         > "$dir/shard_direct_gate.log" 2>&1; then
+      echo "$dir: shard_gate: --shard-direct evidence REFUSED — the" \
+        "direct-plane suite or the partition_data_plane fallback drill" \
+        "failed on this checkout (shard_direct_gate.log); a data plane" \
+        "without a demonstrated escape hatch cannot be blessed"
+      return 1
+    fi
+    touch "$dir/.shard_direct_ok"
   fi
   if [ -f "$dir/.shard_tier_ok" ]; then
     return 0
@@ -487,8 +525,9 @@ shard_gate() {
        env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
        XLA_FLAGS= \
        python -m pytest tests/test_shard.py tests/test_sampler.py \
+         tests/test_shard_direct.py \
          -q -p no:cacheprovider -m 'not slow' \
-         -k 'determinism or kill_shard' \
+         -k 'determinism or kill_shard or shard_direct or coalesce' \
        > "$dir/shard_gate.log" 2>&1; then
     touch "$dir/.shard_tier_ok"
     return 0

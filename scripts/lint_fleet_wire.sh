@@ -14,11 +14,23 @@
 # r2d2dpg_tpu/fleet/ recursively, so a new fleet module (shard.py being
 # the latest) is covered the day it lands.
 #
+# ISSUE 17 splits the fleet wire into control + data planes: the actor
+# dials shard procs directly for SEQS, and the control connection grows
+# a K_STATS accounting frame (tiny trusted dict — pickle-with-annotation
+# like HELLO/ACK).  The recursive scans below already cover the new
+# codec sites (actor.py's data-plane push, ingest.py's K_STATS branch);
+# rule 3 pins the plane split itself: SEQS tensor frames must ride the
+# zero-copy scatter sender on EVERY leg, whichever plane carries them.
+#
 # Rules:
 #   1. The token `pickle` may appear in fleet/ only inside transport.py
 #      (the control-frame codec's single home).
 #   2. `pack_obj(` / `unpack_obj(` calls in fleet/ outside transport.py
 #      must carry the `# wire-lint: control` annotation.
+#   3. K_SEQS frames must be sent via `send_frame_parts` (zero-copy
+#      parts), never the whole-buffer `send_frame` control sender — on
+#      the forwarded ingest leg, the direct actor->shard data plane,
+#      and the learner->shard forward leg alike.
 #
 # Wired into the test run via tests/test_transport.py::test_lint_fleet_wire.
 set -euo pipefail
@@ -48,6 +60,20 @@ if [ -n "$offenders" ]; then
     echo "lint_fleet_wire: FAIL — un-annotated pack_obj/unpack_obj in" \
          "fleet/; SEQS/PARAMS must use fleet/wire.py (control frames:" \
          "annotate the call site with '# wire-lint: control')"
+    fail=1
+fi
+
+# -z lets [^)]* span newlines, so a multi-line send_frame(...) call
+# with K_SEQS anywhere in its argument list is still caught.
+offenders=$(grep -rzl -E 'send_frame\([^)]*K_SEQS' r2d2dpg_tpu/fleet \
+    --include='*.py' | tr '\0' '\n' \
+    | grep -v '^r2d2dpg_tpu/fleet/transport\.py$' || true)
+if [ -n "$offenders" ]; then
+    echo "$offenders"
+    echo "lint_fleet_wire: FAIL — K_SEQS sent through the whole-buffer" \
+         "send_frame control sender; tensor frames must use" \
+         "send_frame_parts on every plane (forwarded, direct, or" \
+         "learner->shard forward leg)"
     fail=1
 fi
 

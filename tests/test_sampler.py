@@ -101,6 +101,14 @@ def test_replay_shards_off_determinism_bit_identical(
                 # in-learner loopback, which must add NOTHING to the run
                 # (scripts/lib_gate.sh shard_gate enforces this pin).
                 "--shard-procs", "0",
+                # The ISSUE 17 off-settings ride it too: --shard-direct 0
+                # keeps the learner-forwarded experience path and the
+                # serial pull loop, BIT-identical to the run with the
+                # flags absent (the direct data plane's fallback IS this
+                # path, so the pin is also the fallback's correctness).
+                "--shard-direct", "0",
+                "--shard-pullers", "0",
+                "--shard-prefetch", "0",
                 "--phases", str(N_TRAIN),
                 "--log-every", str(LOG_EVERY),
                 "--checkpoint-dir", str(tmp_path / "ckpt"),

@@ -1,0 +1,568 @@
+"""Direct actor->shard data plane + concurrent shard pullers (ISSUE 17):
+control/data plane split (fleet/actor.py, ingest.py, shard.py),
+assignment-bearing control acks, K_STATS accounting, per-plane byte
+counters, puller-concurrency determinism, and coalesced PRIO write-back
+(fleet/sampler.py, wire.py).
+
+Anchors ``scripts/lib_gate.sh shard_gate`` adds for ``--shard-direct``
+evidence dirs:
+
+- **assignment/accounting** — the HELLO and STATS acks on the control
+  connection carry the actor's shard assignment (id + dialable address +
+  epoch), and K_STATS frames bank accounting deltas into the SAME sums
+  the forwarded path banks (at-least-once, plane-independent).
+- **plane separation** — bytes on an authenticated ``plane="data"``
+  connection land ONLY in ``r2d2dpg_fleet_data_bytes_{in,out}_total``;
+  the learner's ``forward_bytes_total`` stays untouched (the bench leg's
+  ``shard_forward_bytes == 0`` claim is this counter).
+- **puller determinism** — N concurrent pullers draw bit-identically to
+  the serial control leg: req-ids are assigned and results processed in
+  shard-id order, so arrival order never reaches a seeded draw.
+- **fallback drill** — ``partition_data_plane`` severs the data leg
+  mid-run; the actor falls back LOUDLY to the learner-forwarded path,
+  re-dials from the next ack's advert, and no accounting is lost
+  (the slow e2e below; the gate refuses direct evidence without it).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.configs import PENDULUM_TINY, get_config
+from r2d2dpg_tpu.fleet import transport, wire
+from r2d2dpg_tpu.fleet.ingest import FleetConfig, IngestServer
+from r2d2dpg_tpu.fleet.sampler import SamplerLearner
+from r2d2dpg_tpu.fleet.shard import (
+    RemoteShard,
+    RemoteShardSet,
+    ShardProcTier,
+    ShardServer,
+)
+from r2d2dpg_tpu.fleet.supervisor import SupervisorConfig
+from r2d2dpg_tpu.fleet.transport import (
+    K_ACK,
+    K_HELLO,
+    K_SEQS,
+    K_STATS,
+    hello_auth_proof,
+    pack_hello,
+    pack_obj,
+    recv_frame,
+    send_frame,
+    send_frame_parts,
+    unpack_obj,
+)
+from r2d2dpg_tpu.obs import get_flight_recorder
+from r2d2dpg_tpu.obs import registry as obs_registry
+from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
+from r2d2dpg_tpu.replay.sharded import ReplayShard
+from r2d2dpg_tpu.utils.codes import OK, REFUSED_AUTH
+
+pytestmark = pytest.mark.shard_direct
+
+
+@pytest.fixture
+def fresh_obs(monkeypatch):
+    """A fresh process registry + mirror for one test: the per-plane
+    byte counters are process singletons, and another test's traffic
+    must not leak into this test's deltas."""
+    monkeypatch.setattr(obs_registry, "_REGISTRY", obs_registry.Registry())
+    monkeypatch.setattr(obs_registry, "_MIRROR", obs_registry.RemoteMirror())
+    return obs_registry.get_registry(), obs_registry.get_remote_mirror()
+
+
+def _np_staged(b=3, l=3, prios=(1.0, 2.0, 3.0), seed=1):
+    rng = np.random.default_rng(seed)
+    return StagedSequences(
+        seq=SequenceBatch(
+            obs=rng.normal(size=(b, l, 3)).astype(np.float32),
+            action=rng.normal(size=(b, l, 1)).astype(np.float32),
+            reward=rng.normal(size=(b, l)).astype(np.float32),
+            discount=np.ones((b, l), np.float32),
+            reset=np.zeros((b, l), np.float32),
+            carries={},
+        ),
+        priorities=(
+            None if prios is None else np.asarray(prios, np.float64)
+        ),
+    )
+
+
+def _server(shard_id=0, epoch=1, capacity=8, auth=None):
+    return ShardServer(
+        ReplayShard(capacity, alpha=1.0, shard_id=shard_id),
+        epoch=epoch,
+        seed=0,
+        auth_token=auth,
+    ).start()
+
+
+def _shard_set(srvs, auth=None):
+    addrs = {s.shard.shard_id: s.address for s in srvs}
+    return RemoteShardSet(
+        len(srvs),
+        lambda sid: addrs[sid],
+        wire_config=wire.WireConfig(),
+        auth_token=auth,
+        rejoin_interval_s=0.0,
+    )
+
+
+# ------------------------------------------------------- advert refresh poke
+def test_zero_quota_poke_refreshes_advert_and_preserves_draws():
+    """A zero-quota SAMPLE_REQ refreshes the learner-side advert
+    (occupancy/scaled_sum) without touching the shard's draw rng — the
+    absorb gate's only view of a tier the actors fill DIRECTLY.  Pokes
+    interleaved before a draw leave the draw bit-identical to a never-
+    poked twin server."""
+    staged = _np_staged(b=4, prios=(1.0, 2.0, 3.0, 4.0))
+    srv_a, srv_b = _server(), _server()
+    ss_a, ss_b = _shard_set([srv_a]), _shard_set([srv_b])
+    try:
+        msg = {"staged": staged, "env_steps_delta": 4.0}
+        ss_a.add(0, dict(msg))
+        ss_b.add(0, dict(msg))
+        # A second learner-side view that never exchanged: its advert is
+        # the optimistic zero a direct-plane cold start would read.
+        fresh = RemoteShard(
+            0, lambda: srv_a.address, wire_config=wire.WireConfig(),
+            auth_token=None,
+            max_frame_bytes=transport.MAX_FRAME_BYTES,
+            read_deadline_s=30.0,
+        )
+        assert fresh.occupancy == 0
+        ack = fresh.refresh_advert()
+        assert ack.get("poke") is True
+        assert fresh.occupancy == 4
+        assert fresh.scaled_sum == pytest.approx(10.0)
+        assert fresh.epoch == 1
+        # occupancy_total through the set-level poke: same path the
+        # sampler's absorb gate drives.
+        assert ss_a.refresh_adverts() == 1
+        assert ss_a.occupancy_total() == 4
+        # Draw preservation: poke srv_a a few more times, never srv_b,
+        # then the SAME quota draw from both — bit-identical.
+        for _ in range(3):
+            ss_a.refresh_adverts()
+        ra = ss_a.shards[0].sample(5, req_id=1)
+        rb = ss_b.shards[0].sample(5, req_id=1)
+        np.testing.assert_array_equal(ra["slots"], rb["slots"])
+        np.testing.assert_array_equal(ra["probs"], rb["probs"])
+        np.testing.assert_array_equal(ra["gens"], rb["gens"])
+        fresh.close()
+    finally:
+        ss_a.close()
+        ss_b.close()
+        srv_a.stop()
+        srv_b.stop()
+
+
+# ------------------------------------- assignment acks + K_STATS accounting
+def test_hello_and_stats_acks_carry_assignment_and_bank_accounting():
+    """The control-plane contract: the HELLO ack advertises the actor's
+    shard assignment (id + dialable address + epoch from the tier's
+    address map), a K_STATS frame banks its accounting deltas into the
+    SAME sums the forwarded path banks (``bank_stats``), and the STATS
+    ack re-advertises — the channel an epoch-bumped rejoin's fresh
+    address reaches actors on."""
+    import queue as q
+
+    srv = _server()
+    ss = _shard_set([srv])
+    ingest = IngestServer(
+        q.Queue(maxsize=4),
+        shards=ss,
+        shard_assignment_fn=ss.assignment_for,
+        expected_actors=1,
+    )
+    ingest.start()
+    addr = ingest.connect_address
+    # The advertised epoch is the learner's last-HELLO view of the
+    # shard (advisory; the actor's own data-plane HELLO is the fence) —
+    # poke once so the steady-state value rides the ack.
+    ss.refresh_adverts()
+    sock = None
+    try:
+        sock = transport.connect(addr, read_deadline_s=30.0)
+        send_frame(
+            sock,
+            K_HELLO,
+            pack_hello(
+                {
+                    "actor_id": 0,
+                    **wire.negotiation_fields(wire.WireConfig()),
+                }
+            ),
+        )
+        kind, payload = recv_frame(sock)
+        while kind != K_ACK:
+            kind, payload = recv_frame(sock)
+        ack = unpack_obj(payload)  # wire-lint: control
+        assert ack["code"] == OK
+        assignment = ack["shard_assignment"]
+        assert assignment == {"shard": 0, "address": srv.address, "epoch": 1}
+        # The split-plane accounting frame: deltas only, no staged batch.
+        send_frame(
+            sock,
+            K_STATS,
+            pack_obj(  # wire-lint: control
+                {
+                    "phase": 0,
+                    "param_version": 0,
+                    "env_steps_delta": 16.0,
+                    "ep_return_sum": -2.5,
+                    "ep_count": 2.0,
+                }
+            ),
+        )
+        kind, payload = recv_frame(sock)
+        while kind != K_ACK:
+            kind, payload = recv_frame(sock)
+        ack = unpack_obj(payload)  # wire-lint: control
+        assert ack["code"] == OK
+        assert ack["shard_assignment"]["address"] == srv.address
+        banked = ss.pop_stats()
+        assert banked["env_steps_delta"] == 16.0
+        assert banked["ep_return_sum"] == -2.5
+        assert banked["ep_count"] == 2.0
+    finally:
+        if sock is not None:
+            sock.close()
+        ingest.stop()
+        ss.close()
+        srv.stop()
+
+
+def test_hello_ack_has_no_assignment_without_fn():
+    """--shard-procs 0 / --shard-direct 0: no assignment fn, so control
+    acks never grow the field and actors keep forwarding (the documented
+    fallback; the ``--shard-direct 0`` CLI anchor in test_sampler.py
+    pins the whole path bit-identical)."""
+    import queue as q
+
+    ingest = IngestServer(q.Queue(maxsize=4), expected_actors=1)
+    ingest.start()
+    addr = ingest.connect_address
+    sock = None
+    try:
+        sock = transport.connect(addr, read_deadline_s=30.0)
+        send_frame(
+            sock,
+            K_HELLO,
+            pack_hello(
+                {
+                    "actor_id": 0,
+                    **wire.negotiation_fields(wire.WireConfig()),
+                }
+            ),
+        )
+        kind, payload = recv_frame(sock)
+        while kind != K_ACK:
+            kind, payload = recv_frame(sock)
+        ack = unpack_obj(payload)  # wire-lint: control
+        assert ack["code"] == OK
+        assert "shard_assignment" not in ack
+    finally:
+        if sock is not None:
+            sock.close()
+        ingest.stop()
+
+
+# --------------------------------------------------- per-plane byte counters
+def test_data_plane_seqs_auth_and_byte_separation(fresh_obs):
+    """The data plane holds the control plane's door discipline (HELLO
+    auth with the same token) and its bytes land ONLY in the data-plane
+    counters: the learner-side ``forward_bytes_total`` — the bench leg's
+    ``shard_forward_bytes`` — stays zero through a direct push, and a
+    forwarded push moves it without touching the data-plane counters."""
+    reg, _ = fresh_obs
+    token = "secret"
+    srv = _server(auth=token)
+    ss = _shard_set([srv], auth=token)
+
+    def data_totals():
+        snap = reg.snapshot()
+        return tuple(
+            sum(
+                s["value"]
+                for s in snap.get(name, {}).get("samples", ())
+            )
+            for name in (
+                "r2d2dpg_fleet_data_bytes_in_total",
+                "r2d2dpg_fleet_data_bytes_out_total",
+            )
+        )
+
+    try:
+        # Unauthenticated data-plane dial: refused at the door.
+        bad = transport.connect(srv.address, read_deadline_s=10.0)
+        send_frame(
+            bad,
+            K_HELLO,
+            pack_hello(
+                {
+                    "actor_id": 0,
+                    "plane": "data",
+                    **wire.negotiation_fields(wire.WireConfig()),
+                }
+            ),
+        )
+        kind, payload = recv_frame(bad)
+        assert kind == K_ACK
+        assert unpack_obj(payload)["code"] == (  # wire-lint: control
+            REFUSED_AUTH
+        )
+        bad.close()
+        # Authenticated direct push: the actor's data leg.
+        sock = transport.connect(srv.address, read_deadline_s=10.0)
+        send_frame(
+            sock,
+            K_HELLO,
+            pack_hello(
+                {
+                    "actor_id": 0,
+                    "plane": "data",
+                    "auth": hello_auth_proof(token),
+                    **wire.negotiation_fields(wire.WireConfig()),
+                }
+            ),
+        )
+        kind, payload = recv_frame(sock)
+        while kind != K_ACK:
+            kind, payload = recv_frame(sock)
+        assert unpack_obj(payload)["code"] == OK  # wire-lint: control
+        packer = wire.TreePacker(wire.WireConfig())
+        send_frame_parts(
+            sock, K_SEQS, packer.pack({"staged": _np_staged()})
+        )
+        kind, payload = recv_frame(sock)
+        while kind != K_ACK:
+            kind, payload = recv_frame(sock)
+        advert = unpack_obj(payload)  # wire-lint: control
+        assert advert["occupancy"] == 3
+        d_in, d_out = data_totals()
+        assert d_in > 0 and d_out > 0
+        # The shed forward hop, as a counter: nothing crossed the
+        # learner's ingest leg.
+        assert ss.forward_bytes_total == 0
+        # A forwarded push moves forward_bytes_total and ONLY it.
+        ss.add(0, {"staged": _np_staged()})
+        assert ss.forward_bytes_total > 0
+        assert data_totals() == (d_in, d_out)
+        sock.close()
+    finally:
+        ss.close()
+        srv.stop()
+
+
+# ----------------------------------------------------- puller determinism
+def test_concurrent_pullers_bit_identical_to_serial():
+    """N concurrent pullers == the serial control leg, bitwise: req-ids
+    are assigned in shard-id order BEFORE any exchange dispatches and
+    results are processed in shard-id order after the join, so arrival
+    order cannot reach the learner rng or the assembled batch."""
+    trainer = PENDULUM_TINY.build()
+
+    def pull(pullers: int):
+        srvs = [
+            _server(shard_id=i, capacity=16) for i in range(2)
+        ]
+        ss = _shard_set(srvs)
+        learner = SamplerLearner(
+            trainer,
+            FleetConfig(num_actors=1, shard_pullers=pullers),
+            num_shards=2,
+            shard_set=ss,
+        )
+        try:
+            for sid, seed in ((0, 1), (1, 2)):
+                ss.add(sid, {
+                    "staged": _np_staged(
+                        b=4, prios=(1.0, 2.0, 3.0, 4.0), seed=seed
+                    ),
+                })
+            return learner._pull_phase_batches_remote(
+                12, np.random.default_rng(7)
+            )
+        finally:
+            learner.close()
+            ss.close()
+            for s in srvs:
+                s.stop()
+
+    seq1, probs1, handles1, occ1 = pull(1)
+    seq4, probs4, handles4, occ4 = pull(4)
+    assert occ1 == occ4 == 8
+    np.testing.assert_array_equal(probs1, probs4)
+    for h1, h4 in zip(handles1, handles4):
+        np.testing.assert_array_equal(h1, h4)
+    import jax
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(seq1), jax.tree_util.tree_leaves(seq4)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------- coalesced PRIO write-back
+def test_write_back_coalesces_one_prio_frame_per_shard_epoch():
+    """With-replacement draws repeat (slot, gen) keys within a phase:
+    the write-back dedupes to the LAST verdict and ships ONE PRIO frame
+    per (shard, epoch) — and the shard lands in exactly the state
+    sequential per-key application would have produced."""
+    trainer = PENDULUM_TINY.build()
+    srv = _server(capacity=8)
+    ss = _shard_set([srv])
+    learner = SamplerLearner(
+        trainer,
+        FleetConfig(num_actors=1),
+        num_shards=1,
+        shard_set=ss,
+    )
+    frames = []
+    orig = ss.shards[0].write_back
+
+    def counting_write_back(slots, gens, priorities, *, epoch):
+        frames.append((slots.copy(), priorities.copy()))
+        return orig(slots, gens, priorities, epoch=epoch)
+
+    ss.shards[0].write_back = counting_write_back
+    try:
+        ss.add(0, {"staged": _np_staged(b=4, prios=(1.0, 2.0, 3.0, 4.0))})
+        # Duplicated handles, conflicting verdicts: slot 1 appears three
+        # times — only the LAST (0.5) may land (last-write-wins, exactly
+        # what sequential application does).
+        handles = (
+            np.array([0, 0, 0, 0, 0, 0], np.int64),  # shard_of
+            np.array([1, 2, 1, 3, 1, 0], np.int64),  # slots
+            np.array([1, 1, 1, 1, 1, 1], np.int64),  # gens
+            np.array([1, 1, 1, 1, 1, 1], np.int64),  # epochs
+        )
+        prios = np.array([9.0, 8.0, 7.0, 6.0, 0.5, 5.0], np.float32)
+        learner._write_back_remote(handles, prios)
+        assert len(frames) == 1  # ONE frame for the (shard, epoch) group
+        slots, sent = frames[0]
+        assert len(slots) == 4  # 6 entries, 4 unique keys
+        assert sorted(slots.tolist()) == [0, 1, 2, 3]
+        assert dict(zip(slots.tolist(), sent.tolist()))[1] == 0.5
+        # The shard's resulting sums match sequential application.
+        mirror = ReplayShard(8, alpha=1.0, shard_id=0)
+        staged = _np_staged(b=4, prios=(1.0, 2.0, 3.0, 4.0))
+        mirror.add(staged.seq, staged.priorities)
+        for s, p in zip(handles[1], prios):
+            mirror.update_priorities(
+                np.array([s]), np.array([1]), np.array([p], np.float32)
+            )
+        ack = ss.shards[0].refresh_advert()
+        assert ack["priority_sum"] == pytest.approx(mirror.priority_sum())
+        assert ack["scaled_sum"] == pytest.approx(mirror.scaled_sum())
+    finally:
+        learner.close()
+        ss.close()
+        srv.stop()
+
+
+# ------------------------------------------------------------ e2e drills
+def _direct_e2e(tmp_path, chaos_spec=None):
+    """One real-FleetActor + 2-shard-proc run with the direct data
+    plane; returns (learner stats, learner counters, actor, shard set,
+    flight kinds since start)."""
+    from r2d2dpg_tpu.fleet.actor import FleetActor
+
+    exp = get_config("pendulum_tiny")
+    trainer = exp.build()
+    tier = ShardProcTier(
+        num_shards=2,
+        num_procs=2,
+        capacity_per_shard=128,
+        alpha=trainer.config.priority_alpha,
+        prioritized=True,
+        dirpath=str(tmp_path / "shards"),
+        seed=0,
+        wire_config=wire.WireConfig(),
+        supervisor_config=SupervisorConfig(backoff_base_s=0.2, poll_s=0.05),
+    )
+    learner = SamplerLearner(
+        trainer,
+        FleetConfig(num_actors=1, idle_timeout_s=60, shard_direct=True),
+        num_shards=2,
+        shard_set=tier.shard_set,
+    )
+    tier.start()
+    address = learner.start()
+    actor = FleetActor(
+        exp, actor_id=0, num_actors=1, address=address, seed=0,
+        shard_direct=True, chaos_spec=chaos_spec,
+    )
+    n0 = len(get_flight_recorder().events())
+    t = threading.Thread(target=actor.run, daemon=True)
+    t.start()
+    try:
+        learner.run(4, state=trainer.init(), log_every=0)
+        # Graceful drain BEFORE teardown: the actor finishes its
+        # in-flight exchange, so the conservation ledger below closes.
+        actor.request_drain()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        stats = dict(learner.stats())
+        counters = dict(learner.counters())
+        # Stats banked after the last fold still sit in the set.
+        residue = tier.shard_set.pop_stats()
+        kinds = [
+            e["kind"] for e in get_flight_recorder().events()[n0:]
+        ]
+        return stats, counters, residue, actor, tier, kinds
+    finally:
+        learner.close()
+        tier.stop()
+
+
+@pytest.mark.slow
+def test_direct_data_plane_e2e_sheds_forward_hop(tmp_path):
+    """The tentpole, end to end: a real actor dials its assigned shard
+    from the HELLO ack and every staged batch rides the data plane —
+    the learner forwards ZERO experience bytes, sheds nothing, and the
+    K_STATS control frames keep the accounting ledger exactly whole."""
+    stats, counters, residue, actor, tier, kinds = _direct_e2e(tmp_path)
+    assert stats["train_phases"] == 4.0
+    assert stats["sheds"] == 0.0
+    # The shed forward hop: NOTHING crossed the learner's ingest legs.
+    assert tier.shard_set.forward_bytes_total == 0
+    assert "data_plane_dialed" in kinds
+    assert "data_plane_fallback" not in kinds
+    # Accounting conservation (at-least-once, here exactly-once): every
+    # step the actor collected is banked learner-side or still pending.
+    banked = counters["env_steps_total"] + residue["env_steps_delta"]
+    pending = actor._pending_stats["env_steps_delta"]
+    assert banked + pending == pytest.approx(actor._last_env_steps)
+    assert counters["env_steps_total"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_partition_data_plane_fallback_e2e(tmp_path):
+    """The fallback drill the gate requires for direct evidence: chaos
+    severs the data leg mid-run (``partition_data_plane@p2``); the next
+    direct push fails mid-send, the SAME staged batch retries LOUDLY on
+    the learner-forwarded path (forward bytes move, fallback counter +
+    flight event fire), the next control ack's advert re-dials the data
+    plane, and not one accounting delta is lost across the tear."""
+    stats, counters, residue, actor, tier, kinds = _direct_e2e(
+        tmp_path, chaos_spec="partition_data_plane@p2"
+    )
+    assert stats["train_phases"] == 4.0
+    assert stats["sheds"] == 0.0
+    # The partitioned batch crossed the learner: the LOUD fallback.
+    assert tier.shard_set.forward_bytes_total > 0
+    assert "data_plane_fallback" in kinds
+    # Re-dial after the fallback: dialed at HELLO, again after the tear.
+    assert kinds.count("data_plane_dialed") >= 2
+    # At-least-once accounting across the mid-push kill: the ledger
+    # still closes exactly (the control connection never tore, so the
+    # re-banked deltas were acked exactly once).
+    banked = counters["env_steps_total"] + residue["env_steps_delta"]
+    pending = actor._pending_stats["env_steps_delta"]
+    assert banked + pending == pytest.approx(actor._last_env_steps)
+    assert counters["env_steps_total"] > 0

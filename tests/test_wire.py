@@ -623,6 +623,55 @@ def test_golden_prio_update_exact_bytes():
     assert upd["epoch"] == 4
 
 
+def test_coalesce_prio_update_last_write_wins_and_golden_frame():
+    """PRIO coalescing (ISSUE 17): with-replacement draws repeat (slot,
+    gen) keys within a phase — only each key's LAST priority survives
+    (sequential application is last-write-wins), survivors keep their
+    input order, and a (slot, gen') under a different generation is a
+    DISTINCT key.  The coalesced frame's bytes are exactly the golden
+    ``pack_prio_update`` layout over the deduped arrays — coalescing
+    changes WHAT crosses the boundary, never HOW."""
+    slots = np.array([1, 2, 1, 3, 1], np.int64)
+    gens = np.array([1, 1, 1, 1, 2], np.int64)
+    prios = np.array([9.0, 8.0, 7.0, 6.0, 0.5], np.float32)
+    c_slots, c_gens, c_prios = wire.coalesce_prio_update(slots, gens, prios)
+    # (1,1) repeats at idx 0 and 2 -> keep idx 2 (7.0); (1,2) is its own
+    # key; survivors in input order.
+    np.testing.assert_array_equal(c_slots, [2, 1, 3, 1])
+    np.testing.assert_array_equal(c_gens, [1, 1, 1, 2])
+    np.testing.assert_array_equal(c_prios, [8.0, 7.0, 6.0, 0.5])
+    # Idempotent: coalescing a coalesced stream is the identity.
+    r_slots, r_gens, r_prios = wire.coalesce_prio_update(
+        c_slots, c_gens, c_prios
+    )
+    np.testing.assert_array_equal(r_slots, c_slots)
+    np.testing.assert_array_equal(r_gens, c_gens)
+    np.testing.assert_array_equal(r_prios, c_prios)
+    # Length-mismatch refusal.
+    with pytest.raises(WireFormatError):
+        wire.coalesce_prio_update(slots, gens[:3], prios)
+    # Golden continuity: the ONE frame per (shard, epoch) the remote
+    # write-back now ships is byte-identical to packing the deduped
+    # arrays through the layout pinned above.
+    framed = b"".join(
+        wire.pack_prio_update(
+            TreePacker(WireConfig()), shard=1, slots=c_slots, gens=c_gens,
+            priorities=c_prios, epoch=4,
+        )
+    )
+    want = b"".join(
+        wire.pack_prio_update(
+            TreePacker(WireConfig()),
+            shard=1,
+            slots=np.array([2, 1, 3, 1], np.int64),
+            gens=np.array([1, 1, 1, 2], np.int64),
+            priorities=np.array([8.0, 7.0, 6.0, 0.5], np.float32),
+            epoch=4,
+        )
+    )
+    assert framed == want
+
+
 @pytest.mark.parametrize("encoding", ["f32", "bf16"])
 def test_shard_batch_frame_roundtrip_and_pinned_leaves(encoding):
     """BATCH: the training-ready answer roundtrips on both lanes — the
