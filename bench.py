@@ -634,6 +634,7 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
             "wire_ratio": round(s["wire_ratio"], 3),
             "coalesce_width_mean": round(s["drain_coalesce_width_mean"], 2),
             **_device_cols(s),
+            **_quality_cols(s),
         }
 
     def sampler_leg(
@@ -692,6 +693,7 @@ def _fleet_probe(actor_counts=(1, 2, 3), phases: int = 12) -> None:
             "replay_occupancy": s["replay_occupancy"],
             "sampler_wait_p99_ms": round(s["sampler_wait_p99_ms"], 1),
             **_device_cols(s),
+            **_quality_cols(s),
         }
 
     rec = {
@@ -842,6 +844,25 @@ def _device_cols(stats: dict) -> dict:
         "compile_count": stats.get("compile_count", -1.0),
         "steady_recompiles": stats.get("steady_recompiles", -1.0),
         "peak_hbm_bytes": stats.get("peak_hbm_bytes", 0.0),
+    }
+
+
+def _quality_cols(stats: dict) -> dict:
+    """The experience-quality columns every fleet leg records (ISSUE 18),
+    straight off the learner's stats or the parsed ``fleet:`` line: how
+    STALE (policy lag in param versions), how OLD (replay age in phases
+    or learner steps), and how DIVERSE (ESS/B of the drawn priorities)
+    the experience the run actually trained on was.  -1.0 = the plane
+    never armed on that axis (e.g. lag on an --actors 0 run, where no
+    wire provenance exists)."""
+    return {
+        "quality_lag_mean": stats.get("quality_lag_mean", -1.0),
+        "quality_lag_p99": stats.get("quality_lag_p99", -1.0),
+        "quality_replay_age_mean": stats.get(
+            "quality_replay_age_mean", -1.0
+        ),
+        "quality_ess_frac": stats.get("quality_ess_frac", -1.0),
+        "quality_is_saturation": stats.get("quality_is_saturation", -1.0),
     }
 
 

@@ -36,6 +36,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from r2d2dpg_tpu.configs import CONFIGS, ExperimentConfig, get_config
 from r2d2dpg_tpu.fleet import chaos as fleet_chaos
@@ -569,8 +570,19 @@ class FleetActor:
                 self._pending_stats["env_steps_delta"] += steps_delta
                 self._pending_stats["ep_return_sum"] += float(ret_sum)
                 self._pending_stats["ep_count"] += float(count)
+                # Provenance stamps ride the already-fetched host batch:
+                # the behavior version these sequences were collected
+                # under and this actor's monotone phase clock.  The
+                # learner folds lag/age from them without any extra
+                # device traffic on either side.
+                seq_b = jax.tree_util.tree_leaves(seq_host)[0].shape[0]
                 staged_host = StagedSequences(
-                    seq=seq_host, priorities=prios_host
+                    seq=seq_host,
+                    priorities=prios_host,
+                    behavior_version=np.full(
+                        (seq_b,), self._param_version, np.int64
+                    ),
+                    collect_id=np.full((seq_b,), self._phase, np.int64),
                 )
                 sent_direct = self._data_sock is not None and (
                     self._send_direct(staged_host)

@@ -76,6 +76,11 @@ from r2d2dpg_tpu.fleet.transport import (
 from r2d2dpg_tpu.obs import flight_event, get_registry, get_remote_mirror
 from r2d2dpg_tpu.obs import trace as obs_trace
 from r2d2dpg_tpu.obs.device import flops_of, get_device_monitor
+from r2d2dpg_tpu.obs.quality import (
+    get_quality_plane,
+    policy_lags,
+    quality_stats_columns,
+)
 from r2d2dpg_tpu.replay.arena import stack_staged, staged_nbytes
 from r2d2dpg_tpu.training.pipeline import (
     LearnerState,
@@ -1484,6 +1489,24 @@ class FleetLearner:
                     with t.arena.staged_writer():
                         lstate, _ = self._absorb_prog(lstate, placed)
                     continue
+                # Experience-quality fold (obs/quality.py), host numpy on
+                # the already-decoded batch — zero device traffic.  Under
+                # the central drain EVERY absorbed sequence crosses into
+                # the training arena, so the per-actor counters attribute
+                # train-visible experience by the HELLO-authenticated id
+                # the handler stamped (never the payload's claim), and
+                # the lag distribution is the published-version distance
+                # at the moment the batch enters training.
+                qplane = get_quality_plane()
+                if staged.behavior_version is not None:
+                    qplane.observe_lags(
+                        policy_lags(version, staged.behavior_version)
+                    )
+                for m_q in msgs:
+                    qplane.note_trained(
+                        m_q["actor_id"],
+                        int(np.shape(m_q["staged"].seq.reward)[0]),
+                    )
                 exec_ = self._drain_exec.get(n_seqs)
                 note_width = getattr(t, "dp_note_learn_width", None)
                 if note_width is not None:
@@ -1732,6 +1755,10 @@ class FleetLearner:
                 "drain_coalesce_width_mean": (
                     coalesce_sum / max(coalesce_n, 1)
                 ),
+                # Experience-quality columns (obs/quality.py; the bench
+                # fleet leg's algorithm-health read — -1 means the
+                # signal never armed this run).
+                **quality_stats_columns(),
                 # Device plane (ISSUE 14): this run's compile ledger +
                 # peak HBM — the bench columns, and what an evidence
                 # gate reads off the printed stats line.

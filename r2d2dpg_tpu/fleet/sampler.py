@@ -90,15 +90,38 @@ from r2d2dpg_tpu.fleet.ingest import (
 from r2d2dpg_tpu.obs import flight_event, get_registry
 from r2d2dpg_tpu.obs import trace as obs_trace
 from r2d2dpg_tpu.obs.device import avals_of, flops_of, get_device_monitor
+from r2d2dpg_tpu.obs.quality import (
+    PROVENANCE_ABSENT,
+    get_quality_plane,
+    policy_lags,
+    quality_stats_columns,
+    replay_ages,
+)
 from r2d2dpg_tpu.ops import anneal_beta, importance_weights
 from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
 from r2d2dpg_tpu.replay.sharded import (
     ReplayShard,
+    actor_code,
     combine_probs,
     shard_quotas,
 )
 from r2d2dpg_tpu.training.pipeline import merge_state, split_state
 from r2d2dpg_tpu.training.trainer import Trainer, TrainerState
+
+
+def _resp_provenance(resp: Dict[str, Any]) -> tuple:
+    """(behavior, collect, actors) of one BATCH response, sentinel-filled
+    when the frame carried no provenance (old shard procs) — the quality
+    folds disarm on the sentinel instead of refusing the batch."""
+    n = int(np.shape(resp["slots"])[0])
+
+    def get(k: str) -> np.ndarray:
+        v = resp.get(k)
+        if v is None:
+            return np.full((n,), PROVENANCE_ABSENT, np.int64)
+        return np.asarray(v, np.int64)
+
+    return (get("behavior"), get("collect"), get("actors"))
 
 
 def shard_for_actor(actor_id: Any, num_shards: int) -> int:
@@ -149,6 +172,10 @@ class ShardSet:
             "(re-collectable experience recycled before it was sampled)",
             labelnames=("shard",),
         )
+        # Quality plane (ISSUE 18): evicted-before-ever-sampled churn per
+        # shard — reported from inside the shard's add lock, where the
+        # verdict is exact.
+        qplane = get_quality_plane()
         self.shards = [
             ReplayShard(
                 shard_capacity,
@@ -156,6 +183,11 @@ class ShardSet:
                 prioritized=prioritized,
                 shard_id=i,
                 evict_cb=evict.labels(shard=str(i)).inc,
+                evict_unsampled_cb=(
+                    lambda evicted, unsampled, _i=i: qplane.note_evictions(
+                        _i, evicted, unsampled
+                    )
+                ),
             )
             for i in range(num_shards)
         ]
@@ -197,7 +229,18 @@ class ShardSet:
         shard's max — the central "max" entry semantics), the accounting
         deltas enter the bank.  Never sheds: a full ring FIFO-evicts."""
         staged: StagedSequences = msg["staged"]
-        n = self.shards[shard_id].add(staged.seq, staged.priorities)
+        # msg["actor_id"] is the HELLO-authenticated identity — the
+        # ingest handler overwrites any payload-carried claim before the
+        # message reaches this fold (the PR 6 TELEM posture), so the
+        # slot's actor code can never be spoofed from a SEQS body.
+        actor = msg.get("actor_id")
+        n = self.shards[shard_id].add(
+            staged.seq,
+            staged.priorities,
+            behavior=staged.behavior_version,
+            collect=staged.collect_id,
+            actor=None if actor is None else actor_code(actor),
+        )
         self.bank_stats(msg)
         return n
 
@@ -412,6 +455,12 @@ class SamplerLearner:
         self._phase_stall_s = 0.0  # per-pull dead-tier wait side channel
         self.sample_bytes_total = 0  # SAMPLE_REQ + BATCH + PRIO, with headers
         self.trained_seqs_total = 0
+        # Quality-fold context (ISSUE 18): (published param version,
+        # drained phases) as of the last run-loop iteration — the pull
+        # fold reads it to turn provenance into lag/age without touching
+        # the device (beta is reconstructed from the phase clock, K
+        # updates per phase, exactly the annealed schedule).
+        self._quality_ctx = (0, 0)
         reg = get_registry()
         # Two DISTINCT waits, two histograms: the one-off cold-start /
         # resume absorb (expected to take tens of seconds — compile +
@@ -518,6 +567,39 @@ class SamplerLearner:
         self._obs_bytes.inc(n)
         return unpacker.unpack(payload)
 
+    def _fold_quality(
+        self, behavior, collect, actors, probs, occupancy
+    ) -> None:
+        """Quality-plane fold at the batch-assembly site (ISSUE 18).
+
+        Everything here is host numpy the pull already holds — zero new
+        device fetches.  Lag/age disarm on absent provenance (the -1
+        sentinel masks out inside ``policy_lags``/``replay_ages``); beta
+        is reconstructed from the phase clock (exactly K updates per
+        drained phase, so ``step = phase * K`` matches the in-graph
+        anneal bit-for-bit as a float schedule)."""
+        plane = get_quality_plane()
+        version, phase = self._quality_ctx
+        if behavior is not None:
+            plane.observe_lags(policy_lags(version, behavior))
+        if collect is not None:
+            plane.observe_ages(replay_ages(phase, collect))
+        cfg = self.trainer.config
+        if cfg.prioritized:
+            step = phase * cfg.learner_steps
+            frac = min(step / max(cfg.beta_steps, 1), 1.0)
+            beta = cfg.beta0 + (1.0 - cfg.beta0) * frac
+        else:
+            beta = 0.0
+        plane.observe_probs(probs, occupancy, beta)
+        if actors is not None:
+            a = np.asarray(actors, np.int64).ravel()
+            a = a[a != PROVENANCE_ABSENT]
+            if a.size:
+                codes, counts = np.unique(a, return_counts=True)
+                for c, n in zip(codes, counts):
+                    plane.note_trained(str(int(c)), int(n))
+
     def _pull_phase_batches(
         self, n_draws: int, rng: np.random.Generator, tr=None
     ):
@@ -550,6 +632,7 @@ class SamplerLearner:
         seqs: List[SequenceBatch] = []
         probs: List[np.ndarray] = []
         handles: List[tuple] = []  # (shard, slots, gens) per response
+        prov: List[tuple] = []  # (behavior, collect, actors) per response
         for shard_id, quota in enumerate(quotas):
             if quota == 0:
                 continue
@@ -580,6 +663,9 @@ class SamplerLearner:
                         probs=s.probs,
                         priority_sum=shard.scaled_sum(),
                         occupancy=shard.occupancy(),
+                        behavior=s.behavior,
+                        collect=s.collect,
+                        actors=s.actors,
                     ),
                 )
             )
@@ -588,6 +674,7 @@ class SamplerLearner:
                 combine_probs(resp["probs"], float(sums[shard_id]), total)
             )
             handles.append((req["shard"], resp["slots"], resp["gens"]))
+            prov.append(_resp_provenance(resp))
         seq = jax.tree_util.tree_map(
             lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
             *seqs,
@@ -600,11 +687,21 @@ class SamplerLearner:
         gens = np.concatenate([h[2] for h in handles])
         perm = rng.permutation(n_draws)
         seq = jax.tree_util.tree_map(lambda x: x[perm], seq)
+        occ_total = self.shards.occupancy_total()
+        # Quality fold AT the assembly site (permutation-invariant): the
+        # combined probs + provenance arrays are already on the host.
+        self._fold_quality(
+            np.concatenate([p[0] for p in prov]),
+            np.concatenate([p[1] for p in prov]),
+            np.concatenate([p[2] for p in prov]),
+            prob,
+            occ_total,
+        )
         return (
             seq,
             prob[perm],
             (shard_of[perm], slots[perm], gens[perm]),
-            self.shards.occupancy_total(),
+            occ_total,
         )
 
     def _pull_phase_batches_remote(
@@ -628,6 +725,7 @@ class SamplerLearner:
         slots: List[np.ndarray] = []
         gens: List[np.ndarray] = []
         epochs: List[np.ndarray] = []
+        prov: List[tuple] = []  # (behavior, collect, actors) per response
         remaining = int(n_draws)
         deadline = time.monotonic() + self.config.idle_timeout_s
         stall_t0: Optional[float] = None
@@ -716,22 +814,32 @@ class SamplerLearner:
                 slots.append(np.asarray(resp["slots"], np.int64))
                 gens.append(np.asarray(resp["gens"], np.int64))
                 epochs.append(np.full(n_got, int(resp["epoch"]), np.int64))
+                prov.append(_resp_provenance(resp))
         seq = jax.tree_util.tree_map(
             lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
             *seqs,
         )
         perm = rng.permutation(n_draws)
         seq = jax.tree_util.tree_map(lambda x: x[perm], seq)
+        prob = np.concatenate(probs)
+        occ_total = self.shards.occupancy_total()
+        self._fold_quality(
+            np.concatenate([p[0] for p in prov]),
+            np.concatenate([p[1] for p in prov]),
+            np.concatenate([p[2] for p in prov]),
+            prob,
+            occ_total,
+        )
         return (
             seq,
-            np.concatenate(probs)[perm],
+            prob[perm],
             (
                 np.concatenate(shard_of)[perm],
                 np.concatenate(slots)[perm],
                 np.concatenate(gens)[perm],
                 np.concatenate(epochs)[perm],
             ),
-            self.shards.occupancy_total(),
+            occ_total,
         )
 
     def _exchange_jobs(self, shards, jobs: List[tuple]) -> List[Any]:
@@ -1022,6 +1130,10 @@ class SamplerLearner:
                     break
                 fold_stats()
                 mon.on_phase(drained + 1)
+                # The pull fold's clock view (published version + phase);
+                # a prefetched pull reads the previous iteration's pair —
+                # one phase of skew, same as the sample it describes.
+                self._quality_ctx = (version, drained)
                 if pending is not None:
                     pulled, pending = pending.result(), None
                 else:
@@ -1238,6 +1350,9 @@ class SamplerLearner:
                 "overlap_fraction": max(
                     0.0, 1.0 - (sw_total + sa_total) / wall
                 ),
+                # Experience-quality columns (obs/quality.py; -1 =
+                # signal never armed this run).
+                **quality_stats_columns(),
                 # Device plane (ISSUE 14): compile ledger + peak HBM.
                 **mon.run_stats(),
             }

@@ -100,8 +100,9 @@ from r2d2dpg_tpu.obs import (
     set_flight_identity,
 )
 from r2d2dpg_tpu.obs import trace as obs_trace
+from r2d2dpg_tpu.obs.quality import PROVENANCE_ABSENT, get_quality_plane
 from r2d2dpg_tpu.replay.arena import StagedSequences
-from r2d2dpg_tpu.replay.sharded import ReplayShard
+from r2d2dpg_tpu.replay.sharded import ReplayShard, actor_code
 from r2d2dpg_tpu.utils.codes import OK, REFUSED_AUTH, REFUSED_WIRE
 
 import hmac as _hmac_mod
@@ -280,6 +281,19 @@ class ShardServer:
         )
         if shard._evict_cb is None:
             shard._evict_cb = evict.labels(shard=sid).inc
+        # Quality plane (ISSUE 18): the standalone tier reports its
+        # evicted-before-ever-sampled churn exactly like the in-learner
+        # shards (fleet/sampler.py) — from inside the add lock, where
+        # the verdict is exact.  The shard proc's registry rides TELEM,
+        # so the shard= series land in the learner's one scrape and the
+        # untrained_churn /health rule reads both tiers the same way.
+        if shard._evict_unsampled_cb is None:
+            qplane = get_quality_plane()
+            shard._evict_unsampled_cb = (
+                lambda evicted, unsampled, _sid=shard.shard_id: (
+                    qplane.note_evictions(_sid, evicted, unsampled)
+                )
+            )
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ShardServer":
@@ -485,7 +499,8 @@ class ShardServer:
             # declares plane="data" at HELLO and its bytes land ONLY in
             # the data-plane counters.
             count_in = count_out = lambda n: None  # noqa: E731
-            if str(hello.get("plane", "")) == "data":
+            data_plane = str(hello.get("plane", "")) == "data"
+            if data_plane:
                 count_in = self._obs_data_in.labels(plane="data").inc
                 count_out = self._obs_data_out.labels(plane="data").inc
                 count_in(HEADER_BYTES + len(payload))
@@ -529,7 +544,30 @@ class ShardServer:
                 if kind == K_SEQS:
                     msg = unpacker.unpack(payload)
                     staged: StagedSequences = msg["staged"]
-                    self.shard.add(staged.seq, staged.priorities)
+                    # Slot provenance (ISSUE 18).  The actor code on a
+                    # DIRECT data-plane leg is ``peer`` — the identity
+                    # this connection's auth-checked HELLO bound; the
+                    # frame body's claim is ignored outright (the PR 6
+                    # TELEM posture).  On the learner's forward leg the
+                    # body's ``actor`` IS trustworthy: the learner
+                    # stamped it from its own HELLO-authenticated ingest
+                    # connection before forwarding.
+                    if data_plane:
+                        code = actor_code(peer)
+                    else:
+                        fwd = msg.get("actor")
+                        code = (
+                            None
+                            if fwd is None or int(fwd) == PROVENANCE_ABSENT
+                            else int(fwd)
+                        )
+                    self.shard.add(
+                        staged.seq,
+                        staged.priorities,
+                        behavior=staged.behavior_version,
+                        collect=staged.collect_id,
+                        actor=code,
+                    )
                     if self.chaos is not None:
                         # The stall clock: absorbed SEQS frames (any
                         # connection); arming happens before the gate so
@@ -614,6 +652,9 @@ class ShardServer:
                             priority_sum=self.shard.scaled_sum(),
                             occupancy=self.shard.occupancy(),
                             epoch=self.epoch,
+                            behavior=s.behavior,
+                            collect=s.collect,
+                            actors=s.actors,
                             trace=tr,
                         ),
                         max_frame_bytes=self.max_frame_bytes,
@@ -949,15 +990,31 @@ class RemoteShard:
                         )
 
     # ----------------------------------------------------------------- legs
-    def forward_seqs(self, staged: StagedSequences) -> Dict[str, Any]:
+    def forward_seqs(
+        self, staged: StagedSequences, actor: Optional[int] = None
+    ) -> Dict[str, Any]:
         """SEQS leg: forward one staged batch, return the shard's ack
-        advertisement (already applied)."""
+        advertisement (already applied).
+
+        ``actor`` is the HELLO-authenticated actor code the LEARNER's
+        ingest handler bound for the originating connection — asserted
+        here over the learner's own authenticated leg, so the shard can
+        attribute forwarded slots without trusting anything the actor
+        put in its payload.  Always sent (sentinel when unknown): the
+        connection's cached wire schema must not flex frame-to-frame."""
 
         def do(sock, packer, unpacker):
             n = send_frame_parts(
                 sock,
                 K_SEQS,
-                packer.pack({"staged": staged}),
+                packer.pack(
+                    {
+                        "staged": staged,
+                        "actor": int(
+                            PROVENANCE_ABSENT if actor is None else actor
+                        ),
+                    }
+                ),
                 max_frame_bytes=self.max_frame_bytes,
             )
             self._on_bytes("ingest", n)
@@ -1525,6 +1582,10 @@ class RemoteShardSet:
         staged: StagedSequences = msg["staged"]
         n = int(np.shape(staged.seq.reward)[0])
         self.bank_stats(msg)
+        # The HELLO-authenticated identity the ingest handler stamped —
+        # the payload's own claim never reaches the shard's slot arrays.
+        actor = msg.get("actor_id")
+        code = None if actor is None else actor_code(actor)
         target = int(shard_id)
         while not self._stop.is_set():
             if not self.shards[target].alive:
@@ -1537,7 +1598,7 @@ class RemoteShardSet:
                 time.sleep(0.1)
                 continue
             try:
-                self.shards[target].forward_seqs(staged)
+                self.shards[target].forward_seqs(staged, actor=code)
                 return n
             except ShardUnavailableError as e:
                 if e.not_up:

@@ -221,21 +221,40 @@ def _describe(obj: Any, path: Tuple[str, ...], encoding: str, leaves: List):
 
     Schema nodes are deliberately tiny JSON: ``"n"``/``"i"``/``"f"``/
     ``"t"`` for None/int/float/bool, ``{"d": [[key, child], ...]}`` for
-    dicts, ``{"S": [seq, priorities]}`` / ``{"B": [six fields]}`` for the
-    two registered fleet dataclasses, ``{"a": [storage, wire, shape]}``
+    dicts, ``{"S": [seq, priorities]}`` (4 children when quality
+    provenance is stamped) / ``{"B": [six fields]}`` for the two
+    registered fleet dataclasses, ``{"a": [storage, wire, shape]}``
     for arrays.  Scalar VALUES go in the body (8B slots), so the schema —
     and therefore its crc32 id — is stable across a run's frames."""
     if obj is None:
         return "n"
     if isinstance(obj, StagedSequences):
-        return {
-            "S": [
-                _describe(obj.seq, path + ("seq",), encoding, leaves),
+        children = [
+            _describe(obj.seq, path + ("seq",), encoding, leaves),
+            _describe(
+                obj.priorities, path + ("priorities",), encoding, leaves
+            ),
+        ]
+        # Provenance (ISSUE 18) extends the node to 4 children ONLY when
+        # stamped: a provenance-free staged batch emits the original
+        # 2-child schema, so pre-plane frames — and every golden byte
+        # layout pinned on them — stay byte-identical, and an old decoder
+        # meeting a new ACTOR fails on the schema id, never mid-body.
+        if obj.behavior_version is not None or obj.collect_id is not None:
+            children.append(
                 _describe(
-                    obj.priorities, path + ("priorities",), encoding, leaves
-                ),
-            ]
-        }
+                    obj.behavior_version,
+                    path + ("behavior_version",),
+                    encoding,
+                    leaves,
+                )
+            )
+            children.append(
+                _describe(
+                    obj.collect_id, path + ("collect_id",), encoding, leaves
+                )
+            )
+        return {"S": children}
     if isinstance(obj, SequenceBatch):
         return {
             "B": [
@@ -461,10 +480,19 @@ def _rebuild(node: Any, body, cursor: List[int]) -> Any:
         if tag in ("u", "l") and isinstance(val, list):
             seq = [_rebuild(c, body, cursor) for c in val]
             return tuple(seq) if tag == "u" else seq
-        if tag == "S" and isinstance(val, list) and len(val) == 2:
+        if tag == "S" and isinstance(val, list) and len(val) in (2, 4):
+            # 2 children: a provenance-free frame (old schema, or a
+            # collector that does not stamp) — decodes with provenance
+            # None, which DISARMS the downstream lag/age folds
+            # (obs/quality.py) rather than refusing the frame.
+            fields = [_rebuild(c, body, cursor) for c in val]
+            if len(fields) == 2:
+                return StagedSequences(seq=fields[0], priorities=fields[1])
             return StagedSequences(
-                seq=_rebuild(val[0], body, cursor),
-                priorities=_rebuild(val[1], body, cursor),
+                seq=fields[0],
+                priorities=fields[1],
+                behavior_version=fields[2],
+                collect_id=fields[3],
             )
         if tag == "B" and isinstance(val, list) and len(val) == 6:
             fields = [_rebuild(c, body, cursor) for c in val]
@@ -555,6 +583,9 @@ def pack_shard_batch(
     priority_sum: float,
     occupancy: int,
     epoch: int = 0,
+    behavior: Optional[np.ndarray] = None,
+    collect: Optional[np.ndarray] = None,
+    actors: Optional[np.ndarray] = None,
     trace: Optional[TraceStamp] = None,
 ) -> List[Any]:
     """BATCH payload: a shard's training-ready answer.  ``slots``/``gens``
@@ -577,24 +608,39 @@ def pack_shard_batch(
     collide without the fence).  The in-learner loopback has exactly one
     incarnation and packs the constant 0.
 
+    ``behavior``/``collect``/``actors`` (ISSUE 18) are the drawn slots'
+    quality provenance — behavior param version, collector phase clock,
+    and the shard-stamped HELLO-authenticated actor code per sequence
+    (``obs/quality.py`` sentinel ``-1`` for unknown).  All-or-nothing:
+    omitted entirely (the default) the payload is byte-identical to the
+    pre-plane layout, so the existing golden BATCH tests hold and an
+    old shard's frames decode with the quality folds disarmed rather
+    than refused.
+
     ``trace`` echoes a traced SAMPLE_REQ's sidecar back on the BATCH
     (the packer stamps ``t_encode_end`` with the shard's encode end):
     the id correlates the reply with the learner-side chain, and
     unsampled frames stay byte-identical (the rate-0 anchor)."""
-    return packer.pack(
-        {
-            "req_id": int(req_id),
-            "shard": int(shard),
-            "epoch": int(epoch),
-            "priority_sum": float(priority_sum),
-            "occupancy": int(occupancy),
-            "slots": np.ascontiguousarray(slots, np.int64),
-            "gens": np.ascontiguousarray(gens, np.int64),
-            "probs": np.ascontiguousarray(probs, np.float64),
-            "staged": staged,
-        },
-        trace=trace,
-    )
+    payload = {
+        "req_id": int(req_id),
+        "shard": int(shard),
+        "epoch": int(epoch),
+        "priority_sum": float(priority_sum),
+        "occupancy": int(occupancy),
+        "slots": np.ascontiguousarray(slots, np.int64),
+        "gens": np.ascontiguousarray(gens, np.int64),
+        "probs": np.ascontiguousarray(probs, np.float64),
+    }
+    if behavior is not None or collect is not None or actors is not None:
+        if behavior is None or collect is None or actors is None:
+            raise WireFormatError(
+                "BATCH provenance must be all-present or all-absent"
+            )
+        payload["behavior"] = np.ascontiguousarray(behavior, np.int64)
+        payload["collect"] = np.ascontiguousarray(collect, np.int64)
+        payload["actors"] = np.ascontiguousarray(actors, np.int64)
+    payload["staged"] = staged
+    return packer.pack(payload, trace=trace)
 
 
 def unpack_shard_batch(obj: Any) -> Dict[str, Any]:
@@ -626,6 +672,20 @@ def unpack_shard_batch(obj: Any) -> Dict[str, Any]:
         and np.shape(obj["staged"].seq.reward)[0] == n
     ):
         raise WireFormatError("BATCH handles/probs/sequences length mismatch")
+    # Quality provenance (ISSUE 18): optional as a TRIPLE — absent frames
+    # (an old shard) decode with the folds disarmed, but a frame carrying
+    # a partial or mis-shaped triple is malformed, not "partially armed".
+    prov = [k for k in ("behavior", "collect", "actors") if k in obj]
+    if prov:
+        if len(prov) != 3 or not all(
+            isinstance(obj[k], np.ndarray)
+            and obj[k].dtype == np.int64
+            and obj[k].shape == (n,)
+            for k in prov
+        ):
+            raise WireFormatError("malformed BATCH provenance triple")
+        if any(int(obj[k].min()) < -1 for k in prov if n):
+            raise WireFormatError("BATCH provenance below the -1 sentinel")
     # Range discipline (the validate-before-touch contract): a negative
     # shard index or slot from a confused/hostile peer must refuse HERE,
     # not alias to python negative indexing in the shard's ring arrays.

@@ -23,6 +23,14 @@ One process-wide namespace for every subsystem's operator signals:
   HBM gauges, MFU against ``--device-peak-flops``, and
   ``--profile-window`` profiler captures stamped into the fused
   timeline.
+- ``quality``   — the experience-quality plane (ISSUE 18): sequence
+  provenance (behavior param version + collect phase) stamped at the
+  actor and carried through wire/arena/shard slots, folded at batch
+  assembly into policy-lag/replay-age distributions, ESS/B, IS-weight
+  saturation, per-actor trained-seqs and per-shard
+  evicted-before-sampled fractions (``r2d2dpg_quality_*``), judged by
+  the stale_experience/priority_collapse/untrained_churn/actor_skew
+  /health rules and stamped to ``quality_final.json`` at teardown.
 - ``RemoteMirror`` / ``allgather_into_mirror`` — other processes'
   registry snapshots merged into this process's exporter: ONE scrape
   point per fleet (fed by fleet TELEM frames or an SPMD allgather).
@@ -51,6 +59,12 @@ from r2d2dpg_tpu.obs.flight import (
 from r2d2dpg_tpu.obs.health import (
     HealthConfig,
     HealthEngine,
+)
+from r2d2dpg_tpu.obs import quality  # noqa: F401 - obs.quality.* is the API
+from r2d2dpg_tpu.obs.quality import (
+    QualityPlane,
+    get_quality_plane,
+    reset_quality_plane,
 )
 from r2d2dpg_tpu.obs.registry import (
     Counter,
@@ -82,6 +96,7 @@ __all__ = [
     "HealthEngine",
     "Histogram",
     "MetricsExporter",
+    "QualityPlane",
     "Registry",
     "RemoteMirror",
     "WatchdogConfig",
@@ -91,9 +106,12 @@ __all__ = [
     "flight_event",
     "get_device_monitor",
     "get_flight_recorder",
+    "get_quality_plane",
     "get_registry",
     "get_remote_mirror",
     "merge_remote",
+    "quality",
+    "reset_quality_plane",
     "render_prometheus",
     "set_flight_identity",
     "start_exporter",

@@ -41,6 +41,21 @@ evidence — docs/FLEET.md / docs/REPLAY.md failure matrices):
   fraction of its ``bytes_limit``: the next drain width or batch bump
   OOMs.  Backends without allocator limits (CPU fallback) register no
   limit series, so absence of evidence stays non-degrading.
+- ``stale_experience``   quality policy-lag p99 over ``--quality-max-lag``
+  (obs/quality.py, ISSUE 18): the learner is training on experience
+  collected too many param versions ago.  Warm-up exempt via a sample
+  floor; absent provenance never arms the histogram, so the rule stays
+  disarmed on old-schema fleets.
+- ``priority_collapse``  ESS/B of the trained batches under the floor:
+  the sampling distribution has collapsed onto a handful of slots (a
+  true ESS is always positive, so the never-armed gauge's 0 disarms).
+- ``untrained_churn``    a shard's evicted-before-ever-sampled fraction
+  over threshold once enough evictions accumulated: the ring is
+  recycling experience the learner NEVER looked at — worse than
+  eviction_churn, which also counts sampled-then-evicted slots.
+- ``actor_skew``         one actor's trained-seqs counter far below the
+  fleet mean: a lane of the sigma ladder is not reaching training
+  (dead env pool, wedged actor, or routing starvation).
 
 The verdict is the max severity across findings; every verdict
 TRANSITION lands in the flight ring (``health_verdict`` events), so a
@@ -90,6 +105,10 @@ RULES = (
     "shards_down",
     "recompile_churn",
     "hbm_pressure",
+    "stale_experience",
+    "priority_collapse",
+    "untrained_churn",
+    "actor_skew",
     # The synthetic finding a raising rule degrades into (never a 500):
     # exported like the real rules so a degraded verdict is always
     # attributable to SOME firing series on the scrape.
@@ -135,6 +154,25 @@ class HealthConfig:
     # sets this from the resolved --obs-fleet; the default keeps the
     # standalone-engine behavior (a gauge that exists is judged).
     telem_expected: bool = True
+    # Experience-quality plane (obs/quality.py).  stale_experience judges
+    # the policy-lag p99 only after the histogram holds a real sample
+    # population: the first drained phases after min_replay legitimately
+    # carry warm-up lag (actors filled replay while the learner sat on
+    # version 0), and a p99 over a handful of observations is noise.
+    quality_max_lag: float = 100.0
+    quality_min_lag_count: float = 100.0
+    # A true ESS/B is always positive (probs are positive), so 0 means
+    # the gauge never armed — the floor only judges armed values.
+    quality_ess_floor: float = 0.05
+    # untrained_churn arms once a shard has evicted a real population;
+    # the fraction alone would fire on the first tiny FIFO batch.
+    quality_untrained_frac: float = 0.5
+    quality_churn_min_evictions: float = 256.0
+    # actor_skew needs >=2 actors with a trained-seqs ladder and a real
+    # mean before min/mean is meaningful (the occupancy_skew_min_mean
+    # warm-up posture, keyed on trained sequences instead of slots).
+    quality_actor_skew_frac: float = 0.1
+    quality_actor_skew_min_mean: float = 256.0
 
 
 def _samples(snap: Dict, name: str) -> List[Dict]:
@@ -209,6 +247,10 @@ class HealthEngine:
             self._rule_procs_down,
             self._rule_recompile_churn,
             self._rule_hbm_pressure,
+            self._rule_stale_experience,
+            self._rule_priority_collapse,
+            self._rule_untrained_churn,
+            self._rule_actor_skew,
         )
         reg = self.registry
         self._obs_status = reg.gauge(
@@ -472,6 +514,112 @@ class HealthEngine:
             if v is not None and v > 0:
                 return int(v)
         return self.config.expected_actors
+
+    def _rule_stale_experience(self, snap, findings) -> None:
+        # Provenance-absent frames never observe into this histogram
+        # (obs/quality.py disarms the fold on the -1 sentinel), so an
+        # old-schema fleet simply has no samples here and stays green.
+        for s in _samples(snap, "r2d2dpg_quality_policy_lag"):
+            count = _finite(s.get("count"))
+            if not count or count < self.config.quality_min_lag_count:
+                continue  # warm-up: too few lag observations to judge
+            p99 = _finite(s.get("p99"))
+            if p99 is not None and p99 > self.config.quality_max_lag:
+                findings.append(
+                    {
+                        "rule": "stale_experience",
+                        "severity": VERDICT_DEGRADED,
+                        "detail": "policy-lag p99 over --quality-max-lag — "
+                        "the learner is training on experience collected "
+                        "too many param versions ago (publish cadence, "
+                        "actor pull wedge, or replay far oversized)",
+                        "value": p99,
+                        "threshold": self.config.quality_max_lag,
+                    }
+                )
+
+    def _rule_priority_collapse(self, snap, findings) -> None:
+        for s in _samples(snap, "r2d2dpg_quality_ess_frac"):
+            v = _finite(s.get("value"))
+            if v is None or v <= 0.0:
+                continue  # never armed: a real ESS/B is strictly positive
+            if v < self.config.quality_ess_floor:
+                findings.append(
+                    {
+                        "rule": "priority_collapse",
+                        "severity": VERDICT_DEGRADED,
+                        "detail": "ESS/B of trained batches under the "
+                        "floor — the priority distribution collapsed onto "
+                        "a handful of slots (alpha too hot or a priority "
+                        "spike recycling the same transitions)",
+                        "value": v,
+                        "threshold": self.config.quality_ess_floor,
+                    }
+                )
+
+    def _rule_untrained_churn(self, snap, findings) -> None:
+        # Dedupe per shard label (see _per_shard_max): the learner's
+        # advert mirror and a shard proc's TELEM copy share these names.
+        totals = _per_shard_max(
+            snap, "r2d2dpg_quality_evicted_unsampled_total"
+        )
+        fracs = _per_shard_max(
+            snap, "r2d2dpg_quality_evicted_unsampled_frac"
+        )
+        for shard, frac in fracs.items():
+            if (
+                totals.get(shard, 0.0)
+                < self.config.quality_churn_min_evictions
+            ):
+                continue  # warm-up: not enough evictions to call a trend
+            if frac > self.config.quality_untrained_frac:
+                findings.append(
+                    {
+                        "rule": "untrained_churn",
+                        "severity": VERDICT_DEGRADED,
+                        "detail": f"shard {shard} is evicting experience "
+                        "the learner never sampled — collection outruns "
+                        "training reach (replay undersized or sample "
+                        "quota starving this shard)",
+                        "value": frac,
+                        "threshold": self.config.quality_untrained_frac,
+                    }
+                )
+
+    def _rule_actor_skew(self, snap, findings) -> None:
+        # Dedupe per actor label with max() (monotone counters: the
+        # larger copy is the fresher) — the mirror/TELEM duplication
+        # that motivates _per_shard_max applies to actor series too.
+        per_actor: Dict[object, float] = {}
+        for s in _samples(snap, "r2d2dpg_quality_trained_seqs_total"):
+            v = _finite(s.get("value"))
+            labels = s.get("labels")
+            if v is None or not isinstance(labels, dict):
+                continue
+            actor = labels.get("actor")
+            if actor is None:
+                continue
+            per_actor[actor] = max(per_actor.get(actor, 0.0), v)
+        if len(per_actor) < 2:
+            return  # skew needs a ladder: single-actor runs never fire
+        mean = sum(per_actor.values()) / len(per_actor)
+        if mean < self.config.quality_actor_skew_min_mean:
+            return  # warm-up: the fleet has not trained enough to judge
+        low_actor, low = min(per_actor.items(), key=lambda kv: kv[1])
+        threshold = self.config.quality_actor_skew_frac * mean
+        if low < threshold:
+            findings.append(
+                {
+                    "rule": "actor_skew",
+                    "severity": VERDICT_DEGRADED,
+                    "detail": f"actor {low_actor} trained-seqs far below "
+                    "the fleet mean — its lane of the sigma ladder is "
+                    "not reaching training (dead env pool, wedged "
+                    "actor, or routing starvation)",
+                    "value": low,
+                    "threshold": threshold,
+                }
+            )
 
     # -------------------------------------------------------------- evaluate
     def evaluate(self) -> Dict:

@@ -133,7 +133,9 @@ class DPLearnerTrainer(Trainer):
             critic_carry=P(),
             noise_state=P(),
             window=P(),
-            arena=ArenaState(data=dp, priority=dp, cursor=P(), total_added=P()),
+            arena=ArenaState(
+                data=dp, priority=dp, cursor=P(), total_added=P(), meta=dp
+            ),
             train=P(),
             behavior_params=P(),
             rng=P(),
@@ -167,6 +169,7 @@ class DPLearnerTrainer(Trainer):
                 priority=self._dp_arena,
                 cursor=self._replicated,
                 total_added=self._replicated,
+                meta=self._dp_arena,
             ),
             rng=self._replicated,
         )

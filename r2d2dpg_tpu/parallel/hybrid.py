@@ -122,7 +122,9 @@ class HostSPMDTrainer(Trainer):
         spec = dataclasses.replace(
             _state_spec(),
             env_state=P(),
-            arena=ArenaState(data=P(), priority=P(), cursor=P(), total_added=P()),
+            arena=ArenaState(
+                data=P(), priority=P(), cursor=P(), total_added=P(), meta=P()
+            ),
         )
         self._shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s),

@@ -55,7 +55,9 @@ def _state_spec() -> TrainerState:
         critic_carry=dp,
         noise_state=dp,
         window=dp,
-        arena=ArenaState(data=dp, priority=dp, cursor=rep, total_added=rep),
+        arena=ArenaState(
+                data=dp, priority=dp, cursor=rep, total_added=rep, meta=dp
+            ),
         train=rep,
         behavior_params=rep,
         rng=rep,

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from r2d2dpg_tpu.obs.quality import PROVENANCE_ABSENT
 from r2d2dpg_tpu.ops.priority import PRIORITY_EPS
 
 
@@ -64,6 +65,12 @@ class ArenaState:
     priority: jnp.ndarray  # [capacity] raw priorities; 0 marks empty slots
     cursor: jnp.ndarray  # next write position
     total_added: jnp.ndarray  # monotone count of sequences ever added
+    # Experience-quality slot metadata (ISSUE 18): [capacity, 2] int32 —
+    # column 0 the sequence's behavior param version (staged provenance),
+    # column 1 the learner-step stamp at arena entry (the in-graph
+    # replay-age clock).  PROVENANCE_ABSENT (-1) where unknown; survives
+    # exactly as long as its slot (the ring scatter overwrites both).
+    meta: jnp.ndarray
 
 
 @jax.tree_util.register_dataclass
@@ -87,10 +94,21 @@ class StagedSequences:
     its CURRENT nets, the same staleness class as the phase-locked path);
     a collector that computes priorities locally (Ape-X style, with its
     stale behavior nets) fills it instead.
+
+    ``behavior_version``/``collect_id`` are the experience-quality
+    provenance (ISSUE 18): per-sequence int64 arrays stamping which
+    behavior param version collected each sequence and the collector's
+    monotone phase clock at staging.  ``None`` (the default, and the only
+    value on pre-plane frames) means "unknown" — every downstream fold
+    disarms rather than refuses (obs/quality.py), and the wire codec
+    emits the provenance-free schema so provenance-absent frames stay
+    byte-identical to the pre-plane layout.
     """
 
     seq: SequenceBatch  # leaves [B, L, ...] / carries [B, ...]
     priorities: Any  # [B] float32, or None (learner-computed at drain)
+    behavior_version: Any = None  # [B] int64 behavior param version, or None
+    collect_id: Any = None  # [B] int64 collector phase clock, or None
 
 
 def staged_nbytes(staged: StagedSequences) -> int:
@@ -134,7 +152,24 @@ def stack_staged(batches: Sequence[StagedSequences]) -> StagedSequences:
         if all(resolved)
         else None
     )
-    return StagedSequences(seq=seq, priorities=priorities)
+
+    def _cat_provenance(parts):
+        # Mixed presence DROPS the provenance (disarms the quality folds)
+        # instead of refusing: an old-schema frame coalesced with stamped
+        # ones is a tolerated interop case, unlike mixed priorities which
+        # would silently change ranking semantics.
+        if all(p is not None for p in parts):
+            return np.concatenate([np.asarray(p) for p in parts])
+        return None
+
+    return StagedSequences(
+        seq=seq,
+        priorities=priorities,
+        behavior_version=_cat_provenance(
+            [b.behavior_version for b in batches]
+        ),
+        collect_id=_cat_provenance([b.collect_id for b in batches]),
+    )
 
 
 class _StagedWriterClaim:
@@ -231,13 +266,24 @@ class ReplayArena:
             priority=jnp.zeros((self.capacity,), jnp.float32),
             cursor=jnp.zeros((), jnp.int32),
             total_added=jnp.zeros((), jnp.int32),
+            meta=jnp.full((self.capacity, 2), PROVENANCE_ABSENT, jnp.int32),
         )
 
     # ------------------------------------------------------------------- add
     def add(
-        self, state: ArenaState, batch: SequenceBatch, priorities: jnp.ndarray
+        self,
+        state: ArenaState,
+        batch: SequenceBatch,
+        priorities: jnp.ndarray,
+        meta: Any = None,
     ) -> ArenaState:
-        """Scatter B new sequences at the ring cursor (FIFO overwrite)."""
+        """Scatter B new sequences at the ring cursor (FIFO overwrite).
+
+        ``meta`` is the quality plane's per-slot stamp (``[B, 2]`` int32:
+        behavior version, entry step — see ``ArenaState.meta``); ``None``
+        writes ``PROVENANCE_ABSENT`` so an unstamped add disarms the
+        downstream age/lag folds instead of inheriting the evicted
+        slot's stale metadata."""
         b = priorities.shape[0]
         idx = (state.cursor + jnp.arange(b, dtype=jnp.int32)) % self.capacity
 
@@ -247,14 +293,46 @@ class ReplayArena:
         priority = state.priority.at[idx].set(
             jnp.maximum(priorities, PRIORITY_EPS)
         )
+        if meta is None:
+            meta = jnp.full((b, 2), PROVENANCE_ABSENT, jnp.int32)
+        else:
+            meta = jnp.asarray(meta).astype(jnp.int32)
         return ArenaState(
             data=data,
             priority=priority,
             cursor=(state.cursor + b) % self.capacity,
             total_added=state.total_added + b,
+            meta=state.meta.at[idx].set(meta),
         )
 
-    def add_staged(self, state: ArenaState, staged: StagedSequences) -> ArenaState:
+    def staged_meta(self, staged: StagedSequences, stamp: Any = None) -> Any:
+        """Build the ``add`` meta stamp for a staged batch: column 0 from
+        the staged behavior-version provenance (absent -> sentinel),
+        column 1 from ``stamp`` — the OWNING learner's step clock at
+        absorption, so in-graph replay age is always measured against one
+        process's clock (the actor's ``collect_id`` phase clock serves the
+        host-side shard path instead).  Returns ``None`` (a pure sentinel
+        fill) when neither is known."""
+        if staged.behavior_version is None and stamp is None:
+            return None
+        b = staged.seq.reward.shape[0]
+
+        def col(x):
+            if x is None:
+                return jnp.full((b,), PROVENANCE_ABSENT, jnp.int32)
+            x = jnp.asarray(x).astype(jnp.int32)
+            return jnp.broadcast_to(x, (b,)) if x.ndim == 0 else x
+
+        return jnp.stack(
+            [col(staged.behavior_version), col(stamp)], axis=1
+        )
+
+    def add_staged(
+        self,
+        state: ArenaState,
+        staged: StagedSequences,
+        stamp: Any = None,
+    ) -> ArenaState:
         """Absorb a staged batch (the pipelined executor's drain path).
 
         ``staged.priorities`` must be resolved by the caller (the drain
@@ -285,9 +363,19 @@ class ReplayArena:
             # the writer claim around its compiled call while ANOTHER
             # thread traces a new drain width (the fleet learner's
             # background coalesce-width precompile, fleet/ingest.py).
-            return self.add(state, staged.seq, staged.priorities)
+            return self.add(
+                state,
+                staged.seq,
+                staged.priorities,
+                meta=self.staged_meta(staged, stamp),
+            )
         with self.staged_writer():
-            return self.add(state, staged.seq, staged.priorities)
+            return self.add(
+                state,
+                staged.seq,
+                staged.priorities,
+                meta=self.staged_meta(staged, stamp),
+            )
 
     def staged_writer(self):
         """Non-blocking claim of the single staged-writer slot (a context

@@ -47,12 +47,29 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import numpy as np
 
+from r2d2dpg_tpu.obs.quality import PROVENANCE_ABSENT
 from r2d2dpg_tpu.ops.priority import PRIORITY_EPS
 from r2d2dpg_tpu.replay.arena import SequenceBatch
+
+
+def actor_code(actor_id: Any) -> int:
+    """Slot-storable int64 code for a HELLO-authenticated actor id.
+
+    Fleet actor ids are small non-negative ints ("--actor-id 0"), which
+    map to themselves so the quality plane's ``actor=`` labels match the
+    ids everywhere else in the obs surface; any other identity hashes
+    stably (crc32) into the non-negative code space.  Never returns the
+    ``PROVENANCE_ABSENT`` sentinel."""
+    s = str(actor_id)
+    if s.isdigit():
+        return int(s)
+    import zlib
+
+    return int(zlib.crc32(s.encode("utf-8")))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,12 +81,22 @@ class ShardSample:
     (``combine_probs``) to recover the central distribution's
     per-draw probability for importance weighting.  ``gens`` are the
     sampled slots' generations at sample time — the write-back version
-    key (stale generations are ignored by ``update_priorities``)."""
+    key (stale generations are ignored by ``update_priorities``).
+
+    ``behavior``/``collect``/``actors`` are the drawn slots' quality
+    provenance (ISSUE 18): behavior param version and collector phase
+    clock from the staged stamp, plus the HELLO-authenticated actor code
+    the owning ingest/shard server passed to ``add`` — the sentinel
+    ``PROVENANCE_ABSENT`` (-1) where unknown, so old frames sample
+    cleanly with the quality folds disarmed."""
 
     seq: SequenceBatch  # numpy leaves [n, L, ...]
     slots: np.ndarray  # [n] int64 shard-local slot indices
     gens: np.ndarray  # [n] int64 slot generations at sample time
     probs: np.ndarray  # [n] float64 within-shard probabilities
+    behavior: Any = None  # [n] int64 behavior param versions (or None)
+    collect: Any = None  # [n] int64 collector phase clocks (or None)
+    actors: Any = None  # [n] int64 authenticated actor codes (or None)
 
 
 class ReplayShard:
@@ -89,6 +116,7 @@ class ReplayShard:
         prioritized: bool = True,
         shard_id: int = 0,
         evict_cb=None,
+        evict_unsampled_cb=None,
     ):
         if capacity < 1:
             raise ValueError("shard capacity must be >= 1")
@@ -101,6 +129,13 @@ class ReplayShard:
         self._priority = np.zeros((capacity,), np.float64)  # raw; 0 = empty
         self._scaled = np.zeros((capacity,), np.float64)  # p^alpha (or 1.0)
         self._generation = np.zeros((capacity,), np.int64)
+        # Quality-plane slot metadata (ISSUE 18): stamped at add, handed
+        # back by sample, overwritten with its slot — eviction and
+        # generation bumps can never leave stale provenance behind.
+        self._behavior = np.full((capacity,), PROVENANCE_ABSENT, np.int64)
+        self._collect = np.full((capacity,), PROVENANCE_ABSENT, np.int64)
+        self._actor = np.full((capacity,), PROVENANCE_ABSENT, np.int64)
+        self._ever_sampled = np.zeros((capacity,), bool)
         self._cursor = 0
         self.total_added = 0
         # FIFO-eviction visibility (ISSUE 12 satellite): ring overwrites of
@@ -108,9 +143,14 @@ class ReplayShard:
         # too-small shard silently recycled experience faster than the
         # learner could sample it.  Counted here; ``evict_cb(n)`` (when
         # given) bumps the owner's obs counter under the same add, so the
-        # count and the metric can never drift.
+        # count and the metric can never drift.  ``evict_unsampled_cb
+        # (evicted, unsampled)`` (ISSUE 18) additionally reports how many
+        # of those evictions the learner NEVER sampled — churn the run
+        # paid collect+wire for and trained on zero times.
         self.evictions_total = 0
+        self.evicted_unsampled_total = 0
         self._evict_cb = evict_cb
+        self._evict_unsampled_cb = evict_unsampled_cb
 
     # ------------------------------------------------------------------ add
     def _alloc(self, seq: SequenceBatch) -> None:
@@ -123,7 +163,13 @@ class ReplayShard:
         self._data = jax.tree_util.tree_map(zeros, seq)
 
     def add(
-        self, seq: SequenceBatch, priorities: Optional[np.ndarray]
+        self,
+        seq: SequenceBatch,
+        priorities: Optional[np.ndarray],
+        *,
+        behavior: Optional[np.ndarray] = None,
+        collect: Optional[np.ndarray] = None,
+        actor: Optional[int] = None,
     ) -> int:
         """Ring-write B sequences at the cursor (FIFO overwrite).
 
@@ -131,7 +177,11 @@ class ReplayShard:
         enters at the shard's max priority so far, floor 1.0 — the
         central ``initial_priority="max"`` semantics.  Overwritten slots
         bump their generation, which is what makes a stale write-back
-        detectable.  Returns B."""
+        detectable.  ``behavior``/``collect`` are the staged batch's
+        quality provenance ([B] int64 or None -> sentinel); ``actor`` is
+        the feeding connection's HELLO-AUTHENTICATED id — the caller
+        must never pass a payload-carried id here (the PR 6 TELEM
+        identity posture).  Returns B."""
         import jax
 
         b = int(np.shape(seq.reward)[0])
@@ -145,11 +195,18 @@ class ReplayShard:
                 prios = np.asarray(priorities, np.float64)
             prios = np.maximum(prios, PRIORITY_EPS)
             idx = (self._cursor + np.arange(b)) % self.capacity
-            evicted = int((self._priority[idx] > 0).sum())
+            filled = self._priority[idx] > 0
+            evicted = int(filled.sum())
             if evicted:
+                unsampled = int(
+                    (filled & ~self._ever_sampled[idx]).sum()
+                )
                 self.evictions_total += evicted
+                self.evicted_unsampled_total += unsampled
                 if self._evict_cb is not None:
                     self._evict_cb(evicted)
+                if self._evict_unsampled_cb is not None:
+                    self._evict_unsampled_cb(evicted, unsampled)
             jax.tree_util.tree_map(
                 lambda buf, new: buf.__setitem__(idx, np.asarray(new)),
                 self._data,
@@ -158,6 +215,20 @@ class ReplayShard:
             self._priority[idx] = prios
             self._scaled[idx] = prios**self.alpha if self.prioritized else 1.0
             self._generation[idx] += 1
+            self._behavior[idx] = (
+                PROVENANCE_ABSENT
+                if behavior is None
+                else np.asarray(behavior, np.int64)
+            )
+            self._collect[idx] = (
+                PROVENANCE_ABSENT
+                if collect is None
+                else np.asarray(collect, np.int64)
+            )
+            self._actor[idx] = (
+                PROVENANCE_ABSENT if actor is None else int(actor)
+            )
+            self._ever_sampled[idx] = False
             self._cursor = int((self._cursor + b) % self.capacity)
             self.total_added += b
         return b
@@ -196,11 +267,23 @@ class ReplayShard:
 
             seq = jax.tree_util.tree_map(lambda buf: buf[slots], self._data)
             gens = self._generation[slots].copy()
+            behavior = self._behavior[slots].copy()
+            collect = self._collect[slots].copy()
+            actors = self._actor[slots].copy()
+            # Quality-plane churn accounting: these slots have now been
+            # trained on at least once — a later eviction is ordinary ring
+            # turnover, not untrained churn.  No extra rng is consumed
+            # anywhere in this method (the determinism anchors pin the
+            # draw stream).
+            self._ever_sampled[slots] = True
         return ShardSample(
             seq=seq,
             slots=slots.astype(np.int64),
             gens=gens,
             probs=probs.astype(np.float64),
+            behavior=behavior,
+            collect=collect,
+            actors=actors,
         )
 
     # ------------------------------------------------------- priority update

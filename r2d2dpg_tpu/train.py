@@ -377,6 +377,13 @@ def parse_args(argv=None) -> argparse.Namespace:
         "TELEM staleness above this reads as wedged/partitioned/dead"
     )
     p.add_argument(
+        "--quality-max-lag", type=float, default=100.0, metavar="N",
+        help="/health 'stale_experience' threshold: policy-lag p99 "
+        "(learner param version minus the behavior version stamped on "
+        "trained sequences, obs/quality.py) above this reads as the "
+        "learner training on stale experience"
+    )
+    p.add_argument(
         "--trace-sample", type=float, default=0.0, metavar="RATE",
         help="experience-path tracing: sample this fraction of staged "
         "batches and record per-hop spans (collect -> encode -> transit "
@@ -461,6 +468,7 @@ def _health_config(args) -> "obs.HealthConfig":
         # ride --obs-fleet — without it a growing clock is configuration,
         # not a wedged peer.
         telem_expected=bool(getattr(args, "obs_fleet", 0)),
+        quality_max_lag=args.quality_max_lag,
     )
 
 
@@ -1439,6 +1447,19 @@ def _run_fleet(
                     os.path.join(args.logdir, "health_final.json"), "w"
                 ) as f:
                     json.dump(engine.evaluate(), f, default=str)
+                # The experience-quality plane's end-of-run state (ISSUE
+                # 18): lag/age distributions, ESS/saturation, per-actor
+                # trained counts, per-shard untrained-eviction fractions.
+                # lib_gate.sh quality_gate reads this beside
+                # health_final.json.
+                with open(
+                    os.path.join(args.logdir, "quality_final.json"), "w"
+                ) as f:
+                    json.dump(
+                        obs.get_quality_plane().snapshot_final(),
+                        f,
+                        default=str,
+                    )
             except Exception as e:  # noqa: BLE001 — evidence is optional,
                 # the teardown below it is NOT: an exception escaping this
                 # finally block would skip supervisor/shard-tier/learner

@@ -154,8 +154,18 @@ def drain_staged(
         if prios is None:
             prios = t._initial_priorities(lstate.train, lstate.arena, staged.seq)
         seq, prios = t._reshard_add(staged.seq, prios)
+        # Provenance rides through untouched (same [B] layout as prios);
+        # the entry stamp is the OWNING learner's step clock, so replay
+        # age is measured on one clock per arena (obs/quality.py).
         arena = t.arena.add_staged(
-            lstate.arena, StagedSequences(seq=seq, priorities=prios)
+            lstate.arena,
+            StagedSequences(
+                seq=seq,
+                priorities=prios,
+                behavior_version=staged.behavior_version,
+                collect_id=staged.collect_id,
+            ),
+            stamp=lstate.train.step,
         )
     if not learn:
         return LearnerState(train=lstate.train, arena=arena, rng=rng), {}

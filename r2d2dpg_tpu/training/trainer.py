@@ -419,7 +419,15 @@ class Trainer:
         seq = emit(state.window)
         prios = self._initial_priorities(state.train, state.arena, seq)
         seq, prios = self._reshard_add(seq, prios)
-        arena = self.arena.add(state.arena, seq, prios)
+        # In-process provenance (--actors 0): the LIVE nets collected this
+        # window, so both meta columns carry the current learner step —
+        # behavior version and entry stamp coincide (lag ~0 by
+        # construction, replay age honest; obs/quality.py).
+        meta = jnp.broadcast_to(
+            state.train.step.astype(jnp.int32)[None, None],
+            (prios.shape[0], 2),
+        )
+        arena = self.arena.add(state.arena, seq, prios, meta=meta)
         return dataclasses.replace(state, arena=arena)
 
     def _update_step(self, train, arena, res, key):
@@ -443,6 +451,26 @@ class Trainer:
         )
         if cfg.prioritized:
             arena = self.arena.update_priorities(arena, res.indices, prios)
+        # Experience-quality gauges (obs/quality.py) from values ALREADY
+        # in the graph — they ride the metrics dict to the log cadence's
+        # batched fetch, never a device sync of their own.  ESS/B uses
+        # w'=1/p (the constant cancels); saturation counts weights at the
+        # max-normalized ceiling; replay age reads the arena's entry
+        # stamp (learner-step units), masked where provenance is absent.
+        inv = 1.0 / jnp.maximum(res.probs, 1e-12)
+        metrics = dict(metrics)
+        metrics["quality_ess_frac"] = (inv.sum() ** 2) / (
+            res.probs.shape[0] * jnp.square(inv).sum()
+        )
+        metrics["quality_is_saturation"] = (w >= 1.0 - 1e-9).mean()
+        entry = arena.meta[res.indices, 1]
+        armed = entry >= 0
+        age = jnp.where(
+            armed, jnp.maximum(train.step.astype(jnp.int32) - entry, 0), 0
+        )
+        metrics["quality_replay_age"] = age.sum() / jnp.maximum(
+            armed.sum(), 1
+        )
         return train, arena, metrics
 
     def _learn_step(self, train, arena, key):
@@ -599,6 +627,16 @@ class Trainer:
             self._obs_learner_steps.set(metrics["learner_steps"])
         if metrics.get("episodes"):
             self._obs_episodes.inc(metrics["episodes"])
+        if any(k.startswith("quality_") for k in metrics):
+            # The in-graph quality scalars' host fold (obs/quality.py):
+            # the values rode this cadence's existing batched fetch.
+            from r2d2dpg_tpu.obs.quality import get_quality_plane
+
+            get_quality_plane().publish_scalars(
+                ess_frac=metrics.get("quality_ess_frac"),
+                is_saturation=metrics.get("quality_is_saturation"),
+                replay_age_mean=metrics.get("quality_replay_age"),
+            )
         # Device-plane gauges (HBM in-use/peak, the MFU window) refresh on
         # the same cadence — host-side allocator reads, no device syncs.
         self._device.publish()

@@ -164,6 +164,10 @@ run_evidence() {
         echo "$dir: autoscale recovery gate FAILED (attempt $attempt)"
         continue
       fi
+      if ! quality_gate "$dir" "$@"; then
+        echo "$dir: experience-quality gate FAILED (attempt $attempt)"
+        continue
+      fi
       timeout --kill-after=30 --signal=TERM 1800 \
         env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
         python -m r2d2dpg_tpu.eval $evalflags \
@@ -730,6 +734,68 @@ PYEOF
     return 0
   fi
   return 1
+}
+
+# Experience-quality gate (ISSUE 18): a fleet run (--actors N) with the
+# obs plane armed (--obs-fleet 1) may only be blessed (.done) if its
+# final merged scrape carries an ARMED policy-lag distribution — the
+# r2d2dpg_quality_policy_lag series with count > 0.  On such a run every
+# drained sequence carries wire provenance (the actor stamps its
+# behavior param version at staging), so a scrape without the lag
+# series means the quality plane went dark: the run's numbers cannot
+# say how STALE the experience they trained on was, and a rate measured
+# over unknown-staleness experience is not evidence (the failure mode
+# the plane exists to expose — a fleet can be green on every liveness
+# signal while training on garbage).  The verdict context is stamped
+# quality.txt beside autoscale.txt either way — threshold + armed lag
+# count — so a blessed number always says what staleness bound it was
+# judged under.  Cheap (grep + awk), so it re-runs on every gate pass
+# instead of hiding behind a stamp.  --actors 0 runs pass through
+# untouched: no wire hop means no provenance and the lag axis stays
+# structurally disarmed (docs/OBSERVABILITY.md "Experience-quality
+# plane").
+#   quality_gate <dir> <train args...>
+quality_gate() {
+  local dir=$1
+  shift
+  local _qa=0 _qo=0 _ql="" _q_prev=""
+  local _q_arg
+  for _q_arg in "$@"; do
+    # Both argparse spellings: "--flag value" and "--flag=value".
+    case "$_q_arg" in
+      --actors=*) _qa=${_q_arg#*=} ;;
+      --obs-fleet=*) _qo=${_q_arg#*=} ;;
+      --quality-max-lag=*) _ql=${_q_arg#*=} ;;
+    esac
+    case "$_q_prev" in
+      --actors) _qa=$_q_arg ;;
+      --obs-fleet) _qo=$_q_arg ;;
+      --quality-max-lag) _ql=$_q_arg ;;
+    esac
+    _q_prev=$_q_arg
+  done
+  if [ "${_qa:-0}" = 0 ] || [ "${_qo:-0}" = 0 ]; then
+    return 0  # no wire provenance or no obs plane: lag axis disarmed
+  fi
+  local prom=$dir/metrics_final.prom lag_count
+  if [ ! -f "$prom" ]; then
+    echo "$dir: quality_gate: metrics_final.prom missing — the run left" \
+         "no final scrape to judge experience staleness from"
+    return 1
+  fi
+  lag_count=$(grep -E '^r2d2dpg_quality_policy_lag_count' "$prom" \
+                | awk '{s+=$2} END{print s+0}')
+  printf 'quality_max_lag=%s policy_lag_count=%s\n' \
+    "${_ql:-100.0}" "${lag_count:-0}" > "$dir/quality.txt"
+  if ! awk -v c="${lag_count:-0}" 'BEGIN{exit !(c > 0)}'; then
+    echo "$dir: quality_gate: metrics_final.prom lacks an armed" \
+         "r2d2dpg_quality_policy_lag series (count=$lag_count) on an" \
+         "--actors run with --obs-fleet 1 — the quality plane went dark" \
+         "and the run cannot say how stale its trained experience was;" \
+         "unknown-staleness rates cannot be blessed as evidence"
+    return 1
+  fi
+  return 0
 }
 
 gate_on_box() {

@@ -92,6 +92,26 @@ if Path("r2d2dpg_tpu/obs/device.py").exists():
                 f"r2d2dpg_tpu/obs/device.py: {name} registered but "
                 "missing from METRIC_NAMES"
             )
+# The experience-quality family (obs/quality.py METRIC_NAMES, ISSUE 18):
+# same contract as the device plane — the module enumerates its
+# namespace, each concrete name is held to the scheme, and a
+# r2d2dpg_quality_* registration missing from METRIC_NAMES is an
+# offence.
+if Path("r2d2dpg_tpu/obs/quality.py").exists():
+    from r2d2dpg_tpu.obs.quality import (  # noqa: E402
+        METRIC_NAMES as QUALITY_NAMES,
+    )
+
+    for name in QUALITY_NAMES:
+        if not scheme.match(name) and name not in allow:
+            bad.append(f"r2d2dpg_tpu/obs/quality.py: {name}")
+    declared = set(QUALITY_NAMES)
+    for name in pat.findall(Path("r2d2dpg_tpu/obs/quality.py").read_text()):
+        if name.startswith("r2d2dpg_quality_") and name not in declared:
+            bad.append(
+                f"r2d2dpg_tpu/obs/quality.py: {name} registered but "
+                "missing from METRIC_NAMES"
+            )
 if bad:
     print("\n".join(bad))
     print(

@@ -1,0 +1,456 @@
+"""Experience-quality plane (obs/quality.py, ISSUE 18).
+
+The math anchors pin ESS/B, IS saturation, and the lag/age folds against
+brute force on exact-integer-priority fixtures to 1e-12 — including the
+sharded-vs-central equivalence (the two-level factorization must hand
+importance weighting and the quality plane the SAME per-draw
+probabilities).  The plumbing tests pin the provenance carry (shard slot
+metadata survives eviction and generation bumps; evicted-before-sampled
+accounting), the PR 6 identity posture (trained-seqs attribution keys on
+the HELLO-authenticated id, never a payload-carried one — spoof tests on
+both the ingest and the direct data-plane legs), and the four quality
+/health rules' fire/warm-up/absence-disarm behavior.
+"""
+
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.fleet import transport, wire
+from r2d2dpg_tpu.fleet.ingest import IngestServer
+from r2d2dpg_tpu.fleet.shard import ShardServer
+from r2d2dpg_tpu.fleet.transport import (
+    K_ACK,
+    K_HELLO,
+    K_SEQS,
+    pack_hello,
+    recv_frame,
+    send_frame,
+    send_frame_parts,
+    unpack_obj,
+)
+from r2d2dpg_tpu.obs import registry as obs_registry
+from r2d2dpg_tpu.obs import quality as quality_mod
+from r2d2dpg_tpu.obs.health import HealthConfig, HealthEngine
+from r2d2dpg_tpu.obs.quality import (
+    PROVENANCE_ABSENT,
+    QualityPlane,
+    ess_fraction,
+    is_saturation_fraction,
+    policy_lags,
+    quality_stats_columns,
+    replay_ages,
+)
+from r2d2dpg_tpu.replay.arena import SequenceBatch, StagedSequences
+from r2d2dpg_tpu.replay.sharded import (
+    ReplayShard,
+    actor_code,
+    combine_probs,
+)
+from r2d2dpg_tpu.utils.codes import OK
+
+pytestmark = pytest.mark.quality
+
+import queue  # noqa: E402
+
+
+@pytest.fixture
+def fresh_obs(monkeypatch):
+    """A fresh registry + quality-plane singleton for one test: the
+    plane's counters are process singletons and another test's folds
+    must not leak into this test's verdicts."""
+    monkeypatch.setattr(obs_registry, "_REGISTRY", obs_registry.Registry())
+    monkeypatch.setattr(obs_registry, "_MIRROR", obs_registry.RemoteMirror())
+    quality_mod.reset_quality_plane()
+    yield obs_registry.get_registry()
+    quality_mod.reset_quality_plane()
+
+
+def _np_staged(b=3, l=3, prios=(1.0, 2.0, 3.0), seed=1, **prov):
+    rng = np.random.default_rng(seed)
+    return StagedSequences(
+        seq=SequenceBatch(
+            obs=rng.normal(size=(b, l, 3)).astype(np.float32),
+            action=rng.normal(size=(b, l, 1)).astype(np.float32),
+            reward=rng.normal(size=(b, l)).astype(np.float32),
+            discount=np.ones((b, l), np.float32),
+            reset=np.zeros((b, l), np.float32),
+            carries={},
+        ),
+        priorities=(
+            None if prios is None else np.asarray(prios, np.float64)
+        ),
+        **prov,
+    )
+
+
+# ----------------------------------------------------------- math anchors
+def test_ess_fraction_matches_bruteforce_to_1e12():
+    """Exact-integer-priority fixture: p_i = k_i / K, brute-forced ESS/B
+    term by term in float64 — the closed form must agree to 1e-12, the
+    uniform draw must read exactly 1.0, and a collapsed draw 1/B."""
+    prios = np.array([1, 2, 3, 5, 8, 13, 21, 34], np.int64)
+    probs = prios / prios.sum()
+    w = [1.0 / float(p) for p in probs]
+    brute = (sum(w) ** 2) / (len(w) * sum(x * x for x in w))
+    assert abs(ess_fraction(probs) - brute) < 1e-12
+    assert ess_fraction(np.full(16, 1.0 / 16)) == pytest.approx(1.0, abs=1e-12)
+    # Collapse: one rare low-probability draw's weight (1/p) soaks the
+    # batch -> ESS/B -> 1/B.
+    collapsed = np.array([1.0] * 7 + [1e-9])
+    assert ess_fraction(collapsed) == pytest.approx(1.0 / 8, rel=1e-6)
+    # NaN-free degenerate inputs: empty and non-positive fold to 0.0.
+    assert ess_fraction(np.zeros(0)) == 0.0
+    assert ess_fraction(np.array([0.0, -1.0, np.nan])) == 0.0
+
+
+def test_is_saturation_fraction_matches_bruteforce():
+    """Mirrors ops/priority.importance_weights: w = (N p)^-beta
+    max-normalized — the ceiling lands on the min-probability draws,
+    counted brute-force."""
+    prios = np.array([1, 1, 2, 4], np.float64)
+    probs = prios / prios.sum()
+    n, beta = 32.0, 0.4
+    w = (n * probs) ** (-beta)
+    brute = float(np.mean(w >= w.max() * (1.0 - 1e-9)))
+    got = is_saturation_fraction(probs, occupancy=n, beta=beta)
+    assert abs(got - brute) < 1e-12
+    assert brute == 0.5  # the two min-probability draws
+    # beta=0 flattens every weight to 1.0: the whole batch saturates.
+    assert is_saturation_fraction(probs, n, 0.0) == 1.0
+
+
+def test_policy_lag_and_replay_age_mask_and_clamp():
+    """Sentinel entries are MASKED (absence disarms, never pollutes) and
+    raced-ahead provenance clamps to 0, pinned against an index-by-index
+    brute force."""
+    behavior = np.array([3, PROVENANCE_ABSENT, 7, 9, 5], np.int64)
+    lags = policy_lags(7, behavior)
+    brute = [max(7 - int(v), 0) for v in behavior if v != PROVENANCE_ABSENT]
+    np.testing.assert_array_equal(lags, brute)
+    ages = replay_ages(4, np.array([1, 6, PROVENANCE_ABSENT], np.int64))
+    np.testing.assert_array_equal(ages, [3, 0])
+    assert policy_lags(7, np.full(3, PROVENANCE_ABSENT, np.int64)).size == 0
+
+
+def test_sharded_vs_central_lag_and_ess_equivalence():
+    """Two-level sharded draws must hand the quality plane the same
+    numbers as a central fold: per-slot combined probabilities
+    (combine_probs) equal the central proportional distribution to
+    1e-12 — so ESS/B computed from a sharded batch IS the central ESS —
+    and the lag fold over concatenated per-shard provenance equals the
+    central fold over the unsharded arrays."""
+    prios = np.array([1, 2, 3, 4, 5, 6, 7, 8], np.float64)  # alpha=1 exact
+    central_probs = prios / prios.sum()
+    split = [np.array([0, 2, 4, 6]), np.array([1, 3, 5, 7])]  # interleaved
+    total = float(prios.sum())
+    combined = np.empty_like(central_probs)
+    for idx in split:
+        shard_sum = float(prios[idx].sum())
+        within = prios[idx] / shard_sum
+        combined[idx] = combine_probs(within, shard_sum, total)
+    np.testing.assert_allclose(combined, central_probs, rtol=0, atol=1e-12)
+    assert abs(ess_fraction(combined) - ess_fraction(central_probs)) < 1e-12
+    # Lag distribution: shard-wise folds concatenate to the central fold.
+    behavior = np.array([2, 9, 4, PROVENANCE_ABSENT, 6, 1, 8, 3], np.int64)
+    sharded = np.concatenate(
+        [policy_lags(9, behavior[idx]) for idx in split]
+    )
+    np.testing.assert_array_equal(
+        np.sort(sharded), np.sort(policy_lags(9, behavior))
+    )
+
+
+# ------------------------------------------------------- provenance carry
+def test_shard_slot_provenance_survives_eviction_and_gen_bumps():
+    """Slot metadata is overwritten WITH its slot: after a full ring
+    wrap (eviction + generation bump) every sampled draw carries the
+    second wave's provenance, never the first's."""
+    shard = ReplayShard(4, alpha=1.0)
+    shard.add(
+        _np_staged(b=4, prios=(1.0, 1.0, 1.0, 1.0)).seq,
+        np.ones(4),
+        behavior=np.array([1, 1, 1, 1], np.int64),
+        collect=np.array([10, 10, 10, 10], np.int64),
+        actor=7,
+    )
+    gens_before = shard._generation.copy()
+    shard.add(
+        _np_staged(b=4, prios=(1.0, 1.0, 1.0, 1.0), seed=2).seq,
+        np.ones(4),
+        behavior=np.array([5, 5, 5, 5], np.int64),
+        collect=np.array([20, 20, 20, 20], np.int64),
+        actor=9,
+    )
+    assert (shard._generation == gens_before + 1).all()
+    s = shard.sample(16, np.random.default_rng(0))
+    np.testing.assert_array_equal(s.behavior, np.full(16, 5))
+    np.testing.assert_array_equal(s.collect, np.full(16, 20))
+    np.testing.assert_array_equal(s.actors, np.full(16, 9))
+    # A provenance-free third wave stamps the sentinel back (an old
+    # collector's frames disarm the folds, never inherit stale stamps).
+    shard.add(_np_staged(b=4, prios=(1.0,) * 4, seed=3).seq, np.ones(4))
+    s = shard.sample(8, np.random.default_rng(1))
+    np.testing.assert_array_equal(s.behavior, np.full(8, PROVENANCE_ABSENT))
+    np.testing.assert_array_equal(s.actors, np.full(8, PROVENANCE_ABSENT))
+
+
+def test_evicted_unsampled_accounting(fresh_obs):
+    """evicted-before-ever-sampled: a wrap over never-drawn slots counts
+    every eviction as unsampled (frac 1.0); a wrap over a fully-drawn
+    ring counts none (frac 0.0); the callback feeds the plane's
+    labelled counters per shard."""
+    plane = quality_mod.get_quality_plane()
+    cold = ReplayShard(
+        4,
+        alpha=1.0,
+        shard_id=0,
+        evict_unsampled_cb=lambda e, u: plane.note_evictions(0, e, u),
+    )
+    cold.add(_np_staged(b=4, prios=(1.0,) * 4).seq, np.ones(4))
+    cold.add(_np_staged(b=4, prios=(1.0,) * 4, seed=2).seq, np.ones(4))
+    assert cold.evictions_total == 4
+    assert cold.evicted_unsampled_total == 4
+    hot = ReplayShard(
+        4,
+        alpha=1.0,
+        shard_id=1,
+        evict_unsampled_cb=lambda e, u: plane.note_evictions(1, e, u),
+    )
+    hot.add(_np_staged(b=4, prios=(1.0,) * 4).seq, np.ones(4))
+    drawn = hot.sample(64, np.random.default_rng(0))  # covers all 4 slots
+    assert np.unique(drawn.slots).size == 4
+    hot.add(_np_staged(b=4, prios=(1.0,) * 4, seed=2).seq, np.ones(4))
+    assert hot.evicted_unsampled_total == 0
+    final = plane.snapshot_final()
+    assert final["evictions_by_shard"]["0"] == {
+        "evicted": 4, "unsampled": 4,
+    }
+    assert final["evictions_by_shard"]["1"] == {
+        "evicted": 4, "unsampled": 0,
+    }
+    snap = fresh_obs.snapshot()
+    fracs = {
+        s["labels"]["shard"]: s["value"]
+        for s in snap["r2d2dpg_quality_evicted_unsampled_frac"]["samples"]
+    }
+    assert fracs == {"0": 1.0, "1": 0.0}
+
+
+def test_plane_snapshot_and_stats_columns(fresh_obs):
+    """snapshot_final carries full-run aggregates; quality_stats_columns
+    reads -1 for never-armed axes (absence, not a measured zero) and the
+    real values once the plane armed."""
+    cols = quality_stats_columns()
+    assert all(v == -1.0 for v in cols.values())
+    plane = quality_mod.get_quality_plane()
+    plane.observe_lags(np.array([2, 4, 6]))
+    plane.observe_ages(np.array([1, 3]))
+    plane.observe_probs(np.full(8, 1.0 / 8), occupancy=8, beta=0.4)
+    plane.note_trained("3", 5)
+    plane.note_trained("4", 7)
+    final = plane.snapshot_final()
+    assert final["policy_lag"]["count"] == 3
+    assert final["policy_lag"]["mean"] == pytest.approx(4.0)
+    assert final["policy_lag"]["max"] == 6.0
+    assert final["replay_age"]["mean"] == pytest.approx(2.0)
+    assert final["ess_frac"] == pytest.approx(1.0)
+    assert final["trained_seqs_by_actor"] == {"3": 5, "4": 7}
+    cols = quality_stats_columns()
+    assert cols["quality_lag_mean"] == pytest.approx(4.0)
+    assert cols["quality_ess_frac"] == pytest.approx(1.0)
+    assert cols["quality_replay_age_mean"] == pytest.approx(2.0)
+
+
+# ------------------------------------------- authenticated actor identity
+def test_actor_code_digits_and_hash():
+    """Digit ids map to themselves (the bench's actor labels match their
+    codes); non-digit ids hash to a stable non-negative code that can
+    never collide with the -1 sentinel."""
+    assert actor_code("3") == 3
+    assert actor_code(7) == 7
+    assert actor_code("learner") >= 0
+    assert actor_code("learner") == actor_code("learner")
+    assert actor_code("learner") != PROVENANCE_ABSENT
+
+
+def test_ingest_overwrites_spoofed_payload_actor_id(fresh_obs):
+    """PR 6 TELEM posture on the quality plane: a SEQS payload carrying
+    a forged actor_id reaches the learner with the HELLO-authenticated
+    identity — per-actor trained-seqs attribution can never be steered
+    by payload content."""
+    q: queue.Queue = queue.Queue(maxsize=4)
+    srv = IngestServer(q, address="127.0.0.1:0")
+    srv.start()
+    try:
+        sock = transport.connect(srv.address)
+        sock.settimeout(10)
+        send_frame(
+            sock,
+            K_HELLO,
+            pack_hello(
+                {
+                    "actor_id": 3,
+                    **wire.negotiation_fields(wire.WireConfig()),
+                }
+            ),
+        )
+        kind, payload = recv_frame(sock)
+        assert kind == K_ACK and unpack_obj(payload)["code"] == OK
+        packer = wire.TreePacker(wire.WireConfig())
+        send_frame_parts(
+            sock,
+            K_SEQS,
+            packer.pack(
+                {
+                    "phase": 1,
+                    "param_version": 0,
+                    "env_steps_delta": 3.0,
+                    "ep_return_sum": 0.0,
+                    "ep_count": 0.0,
+                    "actor_id": 999,  # the spoof
+                    "staged": _np_staged(),
+                }
+            ),
+        )
+        kind, payload = recv_frame(sock)
+        assert kind == K_ACK
+        msg = q.get(timeout=10)
+        assert msg["actor_id"] == "3"  # HELLO identity won
+        sock.close()
+    finally:
+        srv.stop()
+
+
+def test_data_plane_slot_attribution_ignores_payload_actor(fresh_obs):
+    """On an authenticated plane="data" leg the shard stamps slots with
+    the HELLO peer's code and IGNORES any payload-carried actor field;
+    the payload field is trusted only on the learner's forward leg,
+    where the learner stamped it from its own authenticated ingest
+    connection."""
+    srv = ShardServer(
+        ReplayShard(8, alpha=1.0, shard_id=0), epoch=1, seed=0
+    ).start()
+    try:
+        sock = transport.connect(srv.address, read_deadline_s=10.0)
+        send_frame(
+            sock,
+            K_HELLO,
+            pack_hello(
+                {
+                    "actor_id": 7,
+                    "plane": "data",
+                    **wire.negotiation_fields(wire.WireConfig()),
+                }
+            ),
+        )
+        kind, payload = recv_frame(sock)
+        while kind != K_ACK:
+            kind, payload = recv_frame(sock)
+        assert unpack_obj(payload)["code"] == OK
+        packer = wire.TreePacker(wire.WireConfig())
+        send_frame_parts(
+            sock,
+            K_SEQS,
+            packer.pack({"staged": _np_staged(), "actor": 999}),  # spoof
+        )
+        kind, payload = recv_frame(sock)
+        while kind != K_ACK:
+            kind, payload = recv_frame(sock)
+        assert unpack_obj(payload)["occupancy"] == 3
+        filled = srv.shard._priority > 0
+        np.testing.assert_array_equal(
+            srv.shard._actor[filled], np.full(3, actor_code("7"))
+        )
+        sock.close()
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------- /health rules
+def _engine(reg, **cfg):
+    return HealthEngine(HealthConfig(**cfg), registry=reg)
+
+
+def _fired(verdict, rule):
+    return [f for f in verdict["findings"] if f["rule"] == rule]
+
+
+def test_stale_experience_rule_fire_and_warmup_disarm(fresh_obs):
+    plane = quality_mod.get_quality_plane()
+    eng = _engine(fresh_obs, quality_max_lag=10.0, quality_min_lag_count=100)
+    # Absence: no lag samples ever -> disarmed.
+    assert not _fired(eng.evaluate(), "stale_experience")
+    # Warm-up: a handful of high-lag observations is not a verdict.
+    plane.observe_lags(np.full(10, 50.0))
+    assert not _fired(eng.evaluate(), "stale_experience")
+    # A real population over threshold fires.
+    plane.observe_lags(np.full(200, 50.0))
+    f = _fired(eng.evaluate(), "stale_experience")
+    assert f and f[0]["value"] > 10.0 and f[0]["threshold"] == 10.0
+    # And a fresh fleet (same count, low lag) stays green.  A plane
+    # reset alone does NOT clear the process registry's histogram
+    # (idempotent re-registration returns the same instrument), so the
+    # green case gets its own registry.
+    reg2 = obs_registry.Registry()
+    QualityPlane(registry=reg2).observe_lags(np.full(200, 1.0))
+    eng2 = _engine(reg2, quality_max_lag=10.0, quality_min_lag_count=100)
+    assert not _fired(eng2.evaluate(), "stale_experience")
+
+
+def test_priority_collapse_rule_fire_and_never_armed_disarm(fresh_obs):
+    plane = quality_mod.get_quality_plane()
+    eng = _engine(fresh_obs, quality_ess_floor=0.05)
+    # Registered-but-never-set gauge reads 0, which DISARMS (a true
+    # ESS/B is strictly positive).
+    assert not _fired(eng.evaluate(), "priority_collapse")
+    plane.publish_scalars(ess_frac=0.01)
+    f = _fired(eng.evaluate(), "priority_collapse")
+    assert f and f[0]["value"] == pytest.approx(0.01)
+    plane.publish_scalars(ess_frac=0.9)
+    assert not _fired(eng.evaluate(), "priority_collapse")
+
+
+def test_untrained_churn_rule_fire_and_warmup_disarm(fresh_obs):
+    plane = quality_mod.get_quality_plane()
+    eng = _engine(
+        fresh_obs,
+        quality_untrained_frac=0.5,
+        quality_churn_min_evictions=256.0,
+    )
+    assert not _fired(eng.evaluate(), "untrained_churn")
+    # Warm-up: a high fraction over a tiny eviction count is not a trend.
+    plane.note_evictions(0, evicted=10, unsampled=10)
+    assert not _fired(eng.evaluate(), "untrained_churn")
+    # A real population over threshold fires, labelled per shard.
+    plane.note_evictions(0, evicted=390, unsampled=290)
+    f = _fired(eng.evaluate(), "untrained_churn")
+    assert f and f[0]["value"] == pytest.approx(300.0 / 400.0)
+    # A shard churning only already-sampled slots stays green.
+    plane.note_evictions(1, evicted=400, unsampled=0)
+    assert len(_fired(eng.evaluate(), "untrained_churn")) == 1
+
+
+def test_actor_skew_rule_fire_and_warmup_disarm(fresh_obs):
+    plane = quality_mod.get_quality_plane()
+    eng = _engine(
+        fresh_obs,
+        quality_actor_skew_frac=0.1,
+        quality_actor_skew_min_mean=256.0,
+    )
+    # Single actor: skew needs a ladder.
+    plane.note_trained("0", 100)
+    assert not _fired(eng.evaluate(), "actor_skew")
+    # Two actors but a warm-up mean (50.5 < 256): disarmed even though
+    # the ratio is already skewed.
+    plane.note_trained("1", 1)
+    assert not _fired(eng.evaluate(), "actor_skew")
+    # Mean past the floor with one starved lane: fires, naming the lane.
+    plane.note_trained("0", 9900)
+    plane.note_trained("1", 29)
+    plane.note_trained("2", 10000)
+    f = _fired(eng.evaluate(), "actor_skew")
+    assert f and "actor 1" in f[0]["detail"]
+    assert f[0]["value"] == pytest.approx(30.0)
+    # A balanced fleet at the same volume stays green.
+    plane.note_trained("1", 9970)
+    assert not _fired(eng.evaluate(), "actor_skew")

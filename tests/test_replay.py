@@ -141,7 +141,9 @@ def _dp_arena_state(arena, batch, prios, mesh):
     rep = NamedSharding(mesh, P())
     state = jax.device_put(
         arena.init_state(batch),
-        ArenaState(data=dp, priority=dp, cursor=rep, total_added=rep),
+        ArenaState(
+            data=dp, priority=dp, cursor=rep, total_added=rep, meta=dp
+        ),
     )
     add = jax.jit(arena.add_staged)
     return add(state, StagedSequences(seq=batch, priorities=prios))

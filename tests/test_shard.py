@@ -609,6 +609,14 @@ def test_chaos_kill_shard_stall_and_partition_e2e(tmp_path, fresh_obs):
             telem_stale_after_s=1e9,
             eviction_churn_per_s=1e18,
             occupancy_skew_min_mean=1e18,
+            # ISSUE 18 quality rules disarmed too: this drill churns a
+            # tiny ring far faster than its starved learner samples, so
+            # untrained_churn would (correctly) stay degraded past the
+            # rejoin and blur the one shards_down window under test.
+            quality_min_lag_count=1e18,
+            quality_ess_floor=0.0,
+            quality_churn_min_evictions=1e18,
+            quality_actor_skew_min_mean=1e18,
             expected_shard_procs=2,
         ),
         registry=fresh_obs[0],

@@ -41,7 +41,7 @@ def _bf16():
     return np.dtype(ml_dtypes.bfloat16)
 
 
-def _staged(b=2, l=3, obs=4, act=2, priorities=True):
+def _staged(b=2, l=3, obs=4, act=2, priorities=True, provenance=False):
     rng = np.random.default_rng(7)
     return StagedSequences(
         seq=SequenceBatch(
@@ -54,6 +54,12 @@ def _staged(b=2, l=3, obs=4, act=2, priorities=True):
         ),
         priorities=(
             np.arange(1.0, b + 1.0, dtype=np.float32) if priorities else None
+        ),
+        behavior_version=(
+            np.arange(5, 5 + b, dtype=np.int64) if provenance else None
+        ),
+        collect_id=(
+            np.arange(9, 9 + b, dtype=np.int64) if provenance else None
         ),
     )
 
@@ -88,6 +94,47 @@ def _expected_payload(msg, encoding):
     def arr_node(name, arr):
         return {"a": [arr.dtype.name, wire_dt(name, arr).name, list(arr.shape)]}
 
+    # The "S" node is 2 children when provenance-free (the pre-plane
+    # layout, byte-identical) and 4 when the collector stamped quality
+    # provenance (ISSUE 18): behavior_version, collect_id int64 arrays
+    # appended after priorities, depth-first like every other leaf.
+    s_children = [
+        {
+            "B": [
+                arr_node("obs", seq.obs),
+                arr_node("action", seq.action),
+                arr_node("reward", seq.reward),
+                arr_node("discount", seq.discount),
+                arr_node("reset", seq.reset),
+                {
+                    "d": [
+                        [
+                            "actor",
+                            arr_node("actor", seq.carries["actor"]),
+                        ]
+                    ]
+                },
+            ]
+        },
+        arr_node("priorities", staged.priorities),
+    ]
+    body_arrays = [
+        ("obs", seq.obs),
+        ("action", seq.action),
+        ("reward", seq.reward),
+        ("discount", seq.discount),
+        ("reset", seq.reset),
+        ("actor", seq.carries["actor"]),
+        ("priorities", staged.priorities),
+    ]
+    if staged.behavior_version is not None:
+        s_children.append(
+            arr_node("behavior_version", staged.behavior_version)
+        )
+        s_children.append(arr_node("collect_id", staged.collect_id))
+        body_arrays.append(("behavior_version", staged.behavior_version))
+        body_arrays.append(("collect_id", staged.collect_id))
+
     schema = {
         "d": [
             ["phase", "i"],
@@ -95,33 +142,7 @@ def _expected_payload(msg, encoding):
             ["env_steps_delta", "f"],
             ["ep_return_sum", "f"],
             ["ep_count", "f"],
-            [
-                "staged",
-                {
-                    "S": [
-                        {
-                            "B": [
-                                arr_node("obs", seq.obs),
-                                arr_node("action", seq.action),
-                                arr_node("reward", seq.reward),
-                                arr_node("discount", seq.discount),
-                                arr_node("reset", seq.reset),
-                                {
-                                    "d": [
-                                        [
-                                            "actor",
-                                            arr_node(
-                                                "actor", seq.carries["actor"]
-                                            ),
-                                        ]
-                                    ]
-                                },
-                            ]
-                        },
-                        arr_node("priorities", staged.priorities),
-                    ]
-                },
-            ],
+            ["staged", {"S": s_children}],
         ]
     }
     sjson = json.dumps(schema, separators=(",", ":")).encode()
@@ -134,15 +155,7 @@ def _expected_payload(msg, encoding):
             struct.pack("<d", msg["ep_count"]),
             *[
                 np.ascontiguousarray(a.astype(wire_dt(n, a))).tobytes()
-                for n, a in (
-                    ("obs", seq.obs),
-                    ("action", seq.action),
-                    ("reward", seq.reward),
-                    ("discount", seq.discount),
-                    ("reset", seq.reset),
-                    ("actor", seq.carries["actor"]),
-                    ("priorities", staged.priorities),
-                )
+                for n, a in body_arrays
             ],
         ]
     )
@@ -879,3 +892,115 @@ def test_coalesce_from_queue_takes_only_whats_there():
     assert coalesce_from_queue(q, 6, 4) == [6, 7, 8, 9]  # limit bucket 4
     assert coalesce_from_queue(q, 6, 2) == [6, 10]  # limit respected
     assert q.empty()
+
+
+# ------------------------------------------- quality provenance (ISSUE 18)
+@pytest.mark.parametrize("encoding", ["f32", "bf16"])
+def test_golden_staged_provenance_exact_bytes(encoding):
+    """Provenance-stamped SEQS: the "S" node grows to 4 children —
+    behavior_version and collect_id int64 arrays appended after
+    priorities — and the frame is byte-for-byte the documented layout on
+    both lanes (int64 provenance is never downcast; a quantized version
+    clock would fabricate policy lags)."""
+    msg = _msg(_staged(provenance=True))
+    payload = b"".join(TreePacker(WireConfig(encoding=encoding)).pack(msg))
+    assert payload == _expected_payload(msg, encoding)
+    out = TreeUnpacker().unpack(payload)
+    staged = out["staged"]
+    assert staged.behavior_version.dtype == np.int64
+    np.testing.assert_array_equal(staged.behavior_version, [5, 6])
+    np.testing.assert_array_equal(staged.collect_id, [9, 10])
+
+
+def test_absent_provenance_keeps_preplane_bytes_and_disarms():
+    """A provenance-free staged batch emits the ORIGINAL 2-child "S"
+    schema — byte-identical to pre-plane frames (different schema id from
+    a stamped frame, so an old decoder meeting a new actor fails at the
+    schema, never mid-body) — and decodes with provenance None, which
+    DISARMS the downstream lag/age folds rather than refusing the
+    frame."""
+    plain = _msg(_staged(provenance=False))
+    stamped = _msg(_staged(provenance=True))
+    p_plain = b"".join(TreePacker(WireConfig()).pack(plain))
+    p_stamped = b"".join(TreePacker(WireConfig()).pack(stamped))
+    # The pre-plane golden holds verbatim for unstamped frames...
+    assert p_plain == _expected_payload(plain, "f32")
+    # ...and the two layouts have distinct schema ids (header crc32).
+    assert p_plain[4:8] != p_stamped[4:8]
+    out = TreeUnpacker().unpack(p_plain)
+    assert out["staged"].behavior_version is None
+    assert out["staged"].collect_id is None
+    # The disarm: absent provenance folds to ZERO samples, not fake lag.
+    from r2d2dpg_tpu.obs.quality import (
+        PROVENANCE_ABSENT,
+        policy_lags,
+        replay_ages,
+    )
+
+    absent = np.full((4,), PROVENANCE_ABSENT, np.int64)
+    assert policy_lags(7, absent).size == 0
+    assert replay_ages(7, absent).size == 0
+
+
+def test_batch_provenance_triple_roundtrip_and_refusals():
+    """BATCH quality provenance is an all-or-nothing TRIPLE
+    (behavior/collect/actors int64 [n], >= -1): present it roundtrips
+    exactly (sentinels included), absent the frame is byte-identical to
+    the pre-plane layout and decodes with the folds disarmed, and a
+    partial or out-of-range triple is malformed — never 'partially
+    armed'."""
+    slots, gens, probs = _sampler_handles()
+    staged = _staged(b=3, priorities=False)
+    behavior = np.array([4, -1, 6], np.int64)  # -1 = sentinel, legal
+    collect = np.array([1, 2, 3], np.int64)
+    actors = np.array([0, 1, -1], np.int64)
+
+    def pack(**prov):
+        return b"".join(
+            wire.pack_shard_batch(
+                TreePacker(WireConfig()),
+                req_id=9,
+                shard=1,
+                staged=staged,
+                slots=slots,
+                gens=gens,
+                probs=probs,
+                priority_sum=12.5,
+                occupancy=3,
+                epoch=2,
+                **prov,
+            )
+        )
+
+    out = wire.unpack_shard_batch(
+        TreeUnpacker().unpack(
+            pack(behavior=behavior, collect=collect, actors=actors)
+        )
+    )
+    for key, want in (
+        ("behavior", behavior), ("collect", collect), ("actors", actors)
+    ):
+        assert out[key].dtype == np.int64
+        np.testing.assert_array_equal(out[key], want)
+    # Absent triple: byte-identical to the pre-plane frame, disarmed keys.
+    plain = wire.unpack_shard_batch(TreeUnpacker().unpack(pack()))
+    assert "behavior" not in plain and "actors" not in plain
+    # Partial triple refused at PACK (the learner-side bug class)...
+    with pytest.raises(WireFormatError, match="all-present or all-absent"):
+        pack(behavior=behavior)
+    # ...and at UNPACK (the hostile/mismatched-peer bug class).
+    ok = TreeUnpacker().unpack(
+        pack(behavior=behavior, collect=collect, actors=actors)
+    )
+    partial = dict(ok)
+    del partial["collect"], partial["actors"]
+    with pytest.raises(WireFormatError, match="provenance triple"):
+        wire.unpack_shard_batch(partial)
+    shaped = dict(ok)
+    shaped["collect"] = collect[:2]
+    with pytest.raises(WireFormatError, match="provenance triple"):
+        wire.unpack_shard_batch(shaped)
+    below = dict(ok)
+    below["behavior"] = np.array([4, -2, 6], np.int64)
+    with pytest.raises(WireFormatError, match="below the -1 sentinel"):
+        wire.unpack_shard_batch(below)
