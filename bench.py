@@ -1433,6 +1433,200 @@ def _autoscale_leg(phases: int = 12) -> dict:
     return leg
 
 
+def _serve_leg(workers: int) -> dict:
+    """One ``python bench.py serve`` leg in a SUBPROCESS: the N-worker
+    router needs ``XLA_FLAGS=--xla_force_host_platform_device_count=2``
+    set before jax initializes (one forced host device per worker), and
+    each leg must see a FRESH process anyway so its compile ledger and
+    registry start clean.  The child prints ONE JSON line
+    (``_serve_leg_worker``); rc/stderr failures come back as an error
+    record instead of raising — the BENCH_SERVE.json line is the
+    contract."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("R2D2DPG_PALLAS_INTERPRET", "1")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+    env["R2D2DPG_BENCH_SERVE_LEG"] = str(workers)
+    rc, stdout, stderr = _run_leg_cmd(
+        [sys.executable, os.path.abspath(__file__)], env
+    )
+    if rc is None:
+        return {"error": f"serve leg workers={workers} exceeded 900s"}
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("workers") == workers:
+            if rc != 0:
+                rec["error"] = f"rc={rc}: {stderr[-300:]}"
+            return rec
+    return {"error": f"rc={rc} with no leg record: {stderr[-300:]}"}
+
+
+def _serve_leg_worker(workers: int) -> None:
+    """Traffic-harness body (child process): open-loop arrival of
+    ``SESSIONS`` concurrent recurrent sessions against a ``workers``-wide
+    router, p50/p99 from each request's INTENDED arrival time.
+
+    Open loop: requests are issued on a fixed schedule regardless of
+    completions (a closed loop would slow its offered load to whatever
+    the service sustains and hide queueing — coordinated omission), so
+    latency for request k is measured from its scheduled arrival
+    ``t0 + k/RATE``, not from whenever the generator got around to it:
+    lat = (enqueued_at - t_sched) + req.latency_s, all on the service's
+    own monotonic clock.
+
+    Steady-state discipline: ``start(warmup=True)`` precompiles every
+    bucket on every worker and ``mark_steady()`` arms the device
+    sentinel BEFORE traffic — ``steady_recompiles`` in the record is the
+    pad-to-bucket claim, measured.  Sheds and affinity violations ride
+    the router's own health aggregate; both must read 0 on the blessed
+    config.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from r2d2dpg_tpu.models import ActorNet
+    from r2d2dpg_tpu.obs.device import get_device_monitor
+    from r2d2dpg_tpu.obs.registry import Registry
+    from r2d2dpg_tpu.serving import OK, build_router
+
+    SESSIONS = 2048
+    STEPS = 3  # recurrent: step 0 resets, 1-2 ride the slab carry
+    RATE = 800.0  # offered req/s, open loop
+    OBS = (12,)
+    # action_dim >= 3: single-column heads hit XLA:CPU's batch-size-
+    # dependent gemv reduction order (docs/SERVING.md "Determinism").
+    actor = ActorNet(action_dim=3, hidden=32, use_lstm=True)
+    params = actor.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1,) + OBS),
+        actor.initial_carry(1),
+        jnp.zeros((1,)),
+    )
+    rng = np.random.default_rng(7)
+    sids = [f"sess-{i}" for i in range(SESSIONS)]
+    obs = rng.standard_normal((SESSIONS,) + OBS).astype(np.float32)
+
+    mon = get_device_monitor().install()
+    mon.begin_run()
+    router = build_router(
+        actor,
+        num_workers=workers,
+        params=params,
+        obs_shape=OBS,
+        max_sessions=SESSIONS,  # per worker: holds the 1-worker leg too
+        max_queue=4096,
+        bucket_sizes=(1, 2, 4, 8, 16, 32, 64),
+        flush_ms=2.0,
+        registry=Registry(),
+        params_step=0,
+    )
+    with router:
+        mon.mark_steady()  # warmup compiled every bucket on every worker
+        total = SESSIONS * STEPS
+        pending = []
+        t0 = time.monotonic()
+        for k in range(total):
+            t_sched = t0 + k / RATE
+            now = time.monotonic()
+            if t_sched > now:
+                time.sleep(t_sched - now)
+            step, i = divmod(k, SESSIONS)
+            req = router.act_async(sids[i], obs[i], reset=(step == 0))
+            pending.append((t_sched, req))
+        lat_ms, ok, shed = [], 0, 0
+        for t_sched, req in pending:
+            assert req.wait(120.0), "request never completed"
+            if req.code == OK:
+                ok += 1
+                lat_ms.append(
+                    ((req.enqueued_at - t_sched) + req.latency_s) * 1e3
+                )
+            else:
+                shed += 1
+        wall = time.monotonic() - t0
+        health = router.health()
+    stats = mon.run_stats()
+    mon.end_run()
+    lat = np.sort(np.asarray(lat_ms)) if lat_ms else np.zeros((1,))
+    rec = {
+        "workers": workers,
+        "sessions": SESSIONS,
+        "steps_per_session": STEPS,
+        "offered_rps": RATE,
+        "requests": total,
+        "ok": ok,
+        "sheds": shed,
+        "affinity_violations": health["affinity_violations"],
+        "sessions_active": health["sessions_active"],
+        "worker_errors": health["worker_errors"],
+        "throughput_rps": round(ok / max(wall, 1e-9), 1),
+        "latency_p50_ms": round(float(np.percentile(lat, 50)), 2),
+        "latency_p99_ms": round(float(np.percentile(lat, 99)), 2),
+        "wall_s": round(wall, 2),
+        "per_worker_requests": {
+            w: snap["requests_ok"]
+            for w, snap in health["per_worker"].items()
+        },
+        "compile_count": stats.get("compile_count", -1.0),
+        "steady_recompiles": stats.get("steady_recompiles", -1.0),
+    }
+    print(json.dumps(rec))
+
+
+def _serve_probe() -> None:
+    """``python bench.py serve`` — the scale-out traffic harness
+    (ISSUE 20): 1-worker vs 2-worker router legs under identical open-
+    loop load, written to BENCH_SERVE.json beside the headline benches.
+
+    HONESTY (the standing single-core caveat, same as BENCH_FLEET.json's
+    dp legs): the 2 forced host devices time-slice this container's
+    single CPU core, so the 2-worker leg pays contention the 1-worker
+    leg doesn't — a p50/p99 regression at N=2 here is the box, not the
+    router; the claims this harness records are the STRUCTURAL ones
+    (affinity_violations == 0, sheds == 0 at steady state,
+    steady_recompiles == 0, per-worker residency matching the hash
+    split).  The latency-scaling claim needs real chips; serve_gate
+    stamps serve_workers.txt into any such evidence dir.
+    """
+    rec = {
+        "metric": "serve_p99_latency_ms",
+        "unit": "ms",
+        "config": "2048 recurrent sessions x3 steps, open loop 800 req/s, "
+        "ActorNet h32 act3, buckets 1..64, forced 2 host devices",
+        "backend": "cpu",
+        "legs": {str(n): _serve_leg(n) for n in (1, 2)},
+        "vs_baseline_note": (
+            "single-core container: 2 forced host devices time-slice one "
+            "CPU core, so cross-leg latency deltas are contention "
+            "artifacts; the recorded claims are affinity_violations=0, "
+            "sheds=0 at steady state, steady_recompiles=0 per leg"
+        ),
+    }
+    leg = rec["legs"].get("2", {})
+    rec["value"] = leg.get("latency_p99_ms", 0.0)
+    if "error" in rec["legs"].get("1", {}) or "error" in leg:
+        rec["error"] = "; ".join(
+            f"workers={n}: {rec['legs'][str(n)]['error']}"
+            for n in (1, 2)
+            if "error" in rec["legs"][str(n)]
+        )[-400:]
+    with open(os.path.join(HERE, "BENCH_SERVE.json"), "w") as f:
+        json.dump(rec, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(rec))
+
+
 def worker() -> None:
     """Measurement body — runs in a child with the backend already pinned."""
     import jax
@@ -1547,7 +1741,9 @@ def worker() -> None:
 
 
 if __name__ == "__main__":
-    if os.environ.get("R2D2DPG_BENCH_WORKER"):
+    if os.environ.get("R2D2DPG_BENCH_SERVE_LEG"):
+        _serve_leg_worker(int(os.environ["R2D2DPG_BENCH_SERVE_LEG"]))
+    elif os.environ.get("R2D2DPG_BENCH_WORKER"):
         worker()
     elif len(sys.argv) > 1 and sys.argv[1] == "fleet":
         # Local CPU probe: never touches the TPU tunnel, so none of the
@@ -1568,6 +1764,11 @@ if __name__ == "__main__":
         # CPU-local, kill_actor drill under --autoscale 1): ONE JSON
         # object — merge into BENCH_FLEET.json's "fleet_autoscale" key.
         print(json.dumps({"fleet_autoscale": _autoscale_leg()}))
+    elif len(sys.argv) > 1 and sys.argv[1] == "serve":
+        # Serving scale-out traffic harness (ISSUE 20; two subprocess
+        # legs, CPU-local on forced host devices): prints ONE JSON object
+        # AND writes it to BENCH_SERVE.json.
+        _serve_probe()
     elif len(sys.argv) > 1 and sys.argv[1] == "fleet_shard_direct":
         # Just the direct-data-plane leg (ISSUE 17; two subprocess
         # sub-runs, direct vs forwarded-serial, CPU-local): ONE JSON

@@ -4,10 +4,29 @@ import os
 
 # dm_control chooses its GL backend once, at import time.  Any entry point
 # in this package may be the first to import dm_control (env construction,
-# the native pool's asset lookup, tests in any order), so pin the headless
-# EGL backend here — before a pixels config needs to render — unless the
-# user chose one explicitly.
-os.environ.setdefault("MUJOCO_GL", "egl")
+# the native pool's asset lookup, tests in any order), so pin a backend
+# here — before a pixels config needs to render — unless the user chose
+# one explicitly.  Headless EGL is the right answer when libEGL exists;
+# without it, dm_control's import (state configs included) dies inside
+# PyOpenGL, so fall back to glfw (imports display-less; renders only if a
+# display appears) and finally to no renderer at all — state-observation
+# envs never render, so they keep working either way.
+
+
+def _default_mujoco_gl() -> str:
+    import ctypes.util
+
+    if ctypes.util.find_library("EGL"):
+        return "egl"
+    try:
+        import glfw  # noqa: F401  (bundled lib; find_library can't see it)
+
+        return "glfw"
+    except Exception:
+        return "disabled"
+
+
+os.environ.setdefault("MUJOCO_GL", _default_mujoco_gl())
 
 from r2d2dpg_tpu.envs.core import Environment, EnvSpec, EnvState, TimeStep
 from r2d2dpg_tpu.envs.dmc_host import DMCHostEnv

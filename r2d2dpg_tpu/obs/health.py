@@ -56,6 +56,15 @@ evidence — docs/FLEET.md / docs/REPLAY.md failure matrices):
 - ``actor_skew``         one actor's trained-seqs counter far below the
   fleet mean: a lane of the sigma ladder is not reaching training
   (dead env pool, wedged actor, or routing starvation).
+- ``serve_queue_saturated``  a routed serving worker's micro-batch queue
+  depth over the saturation fraction of its admission bound: that
+  worker is one burst away from shedding.  Warm-up exempt — the queue
+  legitimately piles while the worker's first bucket compiles, so the
+  rule only judges workers that have served at least one request.
+- ``serve_shed_churn``   a serving worker's shed rate (all shed codes)
+  over threshold, judged per ``worker=`` label on the eviction_churn
+  windowed-rate pattern: sustained shedding on ONE device must name
+  that device, not hide behind a fleet-wide average.
 
 The verdict is the max severity across findings; every verdict
 TRANSITION lands in the flight ring (``health_verdict`` events), so a
@@ -109,6 +118,8 @@ RULES = (
     "priority_collapse",
     "untrained_churn",
     "actor_skew",
+    "serve_queue_saturated",
+    "serve_shed_churn",
     # The synthetic finding a raising rule degrades into (never a 500):
     # exported like the real rules so a degraded verdict is always
     # attributable to SOME firing series on the scrape.
@@ -173,6 +184,14 @@ class HealthConfig:
     # warm-up posture, keyed on trained sequences instead of slots).
     quality_actor_skew_frac: float = 0.1
     quality_actor_skew_min_mean: float = 256.0
+    # Serving scale-out plane (serving/router.py, ISSUE 20).  Queue depth
+    # is judged per worker only once that worker has served >= 1 request
+    # (warm-up exemption: admission legitimately piles while the first
+    # bucket compiles); sheds are judged as a per-worker windowed rate
+    # with the eviction_churn burst guard.
+    serve_queue_saturated_frac: float = 0.9
+    serve_shed_per_s: float = 1.0
+    serve_shed_rate_min_dt_s: float = 5.0
 
 
 def _samples(snap: Dict, name: str) -> List[Dict]:
@@ -183,27 +202,32 @@ def _samples(snap: Dict, name: str) -> List[Dict]:
     return [s for s in samples if isinstance(s, dict)]
 
 
-def _per_shard_max(snap: Dict, name: str) -> Dict[object, float]:
-    """One value per shard from a possibly-duplicated family: a shard's
-    series can appear TWICE in a merged snapshot — the learner's advert
-    mirror and the shard proc's TELEM copy share the metric name
-    (deployment, not semantics) — so samples dedupe on their ``shard``
-    label with max() (for monotone counters the larger IS the fresher
-    copy; for occupancy it errs toward "holds data").  Samples without a
-    shard label keep their own slots."""
-    per_shard: Dict[object, float] = {}
+def _per_label_max(snap: Dict, name: str, label: str) -> Dict[object, float]:
+    """One value per ``label`` from a possibly-duplicated family: a
+    series can appear TWICE in a merged snapshot — a local copy and a
+    TELEM-mirrored copy share the metric name (deployment, not
+    semantics) — so samples dedupe on the label with max() (for monotone
+    counters the larger IS the fresher copy; for gauges it errs toward
+    the worse reading).  Samples without the label keep their own slots."""
+    per_label: Dict[object, float] = {}
     for i, s in enumerate(_samples(snap, name)):
         v = _finite(s.get("value"))
         if v is None:
             continue
         labels = s.get("labels")
         key = (
-            labels.get("shard")
-            if isinstance(labels, dict) and "shard" in labels
+            labels.get(label)
+            if isinstance(labels, dict) and label in labels
             else ("unlabelled", i)
         )
-        per_shard[key] = max(per_shard.get(key, 0.0), v)
-    return per_shard
+        per_label[key] = max(per_label.get(key, 0.0), v)
+    return per_label
+
+
+def _per_shard_max(snap: Dict, name: str) -> Dict[object, float]:
+    """One value per shard — the learner's advert mirror and the shard
+    proc's TELEM copy share metric names; see ``_per_label_max``."""
+    return _per_label_max(snap, name, "shard")
 
 
 def _finite(v) -> Optional[float]:
@@ -239,6 +263,10 @@ class HealthEngine:
         self._evict_rate: Optional[float] = None  # last full-window rate
         self._recompile_last: Optional[tuple] = None  # (t_mono, total)
         self._recompile_new: Optional[float] = None  # last full window's new
+        # serve_shed_churn keeps one rate window PER worker label (the
+        # rule's whole point is naming the shedding device).
+        self._serve_shed_last: Dict[object, tuple] = {}  # w -> (t, total)
+        self._serve_shed_rate: Dict[object, float] = {}  # w -> full-window
         self._rules = (
             self._rule_learner_starving,
             self._rule_telem_stale,
@@ -251,6 +279,8 @@ class HealthEngine:
             self._rule_priority_collapse,
             self._rule_untrained_churn,
             self._rule_actor_skew,
+            self._rule_serve_queue_saturated,
+            self._rule_serve_shed_churn,
         )
         reg = self.registry
         self._obs_status = reg.gauge(
@@ -620,6 +650,102 @@ class HealthEngine:
                     "threshold": threshold,
                 }
             )
+
+    def _rule_serve_queue_saturated(self, snap, findings) -> None:
+        # Dedupe every family per worker label (_per_label_max): a future
+        # cross-process serving tier mirrors these series the same way
+        # shard TELEM does, and gauges err toward the worse reading.
+        depths = _per_label_max(snap, "r2d2dpg_serve_queue_depth", "worker")
+        if not depths:
+            return  # no routed serving workers in this process: disarmed
+        limits = _per_label_max(snap, "r2d2dpg_serve_queue_limit", "worker")
+        served = _per_label_max(
+            snap, "r2d2dpg_serve_requests_total", "worker"
+        )
+        for worker, depth in sorted(depths.items(), key=str):
+            limit = limits.get(worker)
+            if limit is None or limit <= 0:
+                continue
+            if served.get(worker, 0.0) <= 0:
+                # Warm-up exemption: admission piles up while this
+                # worker's first bucket compiles — saturation is only a
+                # finding once it has proven it can drain at all.
+                continue
+            threshold = self.config.serve_queue_saturated_frac * limit
+            if depth >= threshold:
+                findings.append(
+                    {
+                        "rule": "serve_queue_saturated",
+                        "severity": VERDICT_DEGRADED,
+                        "detail": f"serving worker {worker} queue depth "
+                        "at the saturation fraction of its admission "
+                        "bound — one burst away from shedding (grow "
+                        "--serve-workers, raise --max-queue, or slow "
+                        "the client)",
+                        "value": depth,
+                        "threshold": threshold,
+                    }
+                )
+
+    def _rule_serve_shed_churn(self, snap, findings) -> None:
+        # Sheds are labelled {worker, code}; dedupe per cell with max()
+        # (monotone counters, mirror-safe), then sum a worker's codes —
+        # the rule judges "this worker is shedding", whatever the mode.
+        cells: Dict[tuple, float] = {}
+        for s in _samples(snap, "r2d2dpg_serve_sheds_total"):
+            v = _finite(s.get("value"))
+            labels = s.get("labels")
+            if v is None or not isinstance(labels, dict):
+                continue
+            worker = labels.get("worker")
+            if worker is None:
+                continue
+            key = (worker, labels.get("code"))
+            cells[key] = max(cells.get(key, 0.0), v)
+        if not cells:
+            return  # no routed serving workers in this process: disarmed
+        per_worker: Dict[object, float] = {}
+        for (worker, _code), v in cells.items():
+            per_worker[worker] = per_worker.get(worker, 0.0) + v
+        now = time.monotonic()
+        for worker in sorted(per_worker, key=str):
+            total = per_worker[worker]
+            with self._lock:
+                last = self._serve_shed_last.get(worker)
+                if (
+                    last is not None
+                    and now - last[0] < self.config.serve_shed_rate_min_dt_s
+                ):
+                    # Sub-window poll gap: re-judge the last FULL window
+                    # (the eviction_churn burst guard) — one shed burst
+                    # over a 0.5s curl gap is not a sustained rate.
+                    rate = self._serve_shed_rate.get(worker)
+                else:
+                    if last is not None and now > last[0]:
+                        self._serve_shed_rate[worker] = max(
+                            total - last[1], 0.0
+                        ) / (now - last[0])
+                    self._serve_shed_last[worker] = (now, total)
+                    rate = (
+                        self._serve_shed_rate.get(worker)
+                        if last is not None
+                        else None
+                    )
+            if rate is None:
+                continue  # first sighting of this worker: no window yet
+            if rate > self.config.serve_shed_per_s:
+                findings.append(
+                    {
+                        "rule": "serve_shed_churn",
+                        "severity": VERDICT_DEGRADED,
+                        "detail": f"serving worker {worker} is shedding "
+                        "at a sustained rate — its admission bound or "
+                        "session slab is persistently full (grow "
+                        "--serve-workers or per-worker capacity)",
+                        "value": rate,
+                        "threshold": self.config.serve_shed_per_s,
+                    }
+                )
 
     # -------------------------------------------------------------- evaluate
     def evaluate(self) -> Dict:

@@ -38,6 +38,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.6; on older jax device_put performs the same layout move
+    _reshard = jax.sharding.reshard
+except AttributeError:
+    _reshard = jax.device_put
+
 from r2d2dpg_tpu.agents.ddpg import R2D2DPG
 from r2d2dpg_tpu.envs.dmc_host import DMCHostEnv
 from r2d2dpg_tpu.parallel.mesh import DP_AXIS
@@ -319,14 +324,14 @@ class HostSPMDTrainer(Trainer):
     def _reshard_add(self, seq, prios):
         """Replicate the E fresh sequences + priorities for the (replicated)
         arena add — after initial_priority ran on the dp-sharded layout."""
-        rep = lambda x: jax.sharding.reshard(x, self._replicated)  # noqa: E731
+        rep = lambda x: _reshard(x, self._replicated)  # noqa: E731
         return jax.tree_util.tree_map(rep, seq), rep(prios)
 
     def _reshard_batch(self, batch):
         """Shard the sampled batch over dp so learner compute splits and XLA
         psums the gradients (params replicated + batch sharded)."""
         return jax.tree_util.tree_map(
-            lambda x: jax.sharding.reshard(
+            lambda x: _reshard(
                 x, NamedSharding(self.mesh, P(*([DP_AXIS] + [None] * (x.ndim - 1))))
             ),
             batch,

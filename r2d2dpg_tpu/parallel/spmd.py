@@ -43,6 +43,15 @@ try:  # jax >= 0.7 exposes shard_map at top level
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
+# The replication-check kwarg was renamed check_rep -> check_vma.
+import inspect as _inspect
+
+_CHECK_KW = (
+    {"check_vma": False}
+    if "check_vma" in _inspect.signature(shard_map).parameters
+    else {"check_rep": False}
+)
+
 
 def _state_spec() -> TrainerState:
     """PartitionSpec prefix-tree for TrainerState under the ``dp`` mesh."""
@@ -125,7 +134,7 @@ class SPMDTrainer(Trainer):
         def wrap(fn, out_specs):
             mapped = shard_map(
                 fn, mesh=mesh, in_specs=(spec,), out_specs=out_specs,
-                check_vma=False,
+                **_CHECK_KW,
             )
             return jax.jit(mapped, donate_argnums=(0,))
 

@@ -53,8 +53,19 @@ def parse_args(argv=None) -> argparse.Namespace:
         "--max-queue", type=int, default=256,
         help="admission bound; beyond it requests shed with shed_queue_full"
     )
+    # Scale-out (docs/SERVING.md "Scale-out").
+    p.add_argument(
+        "--serve-workers", type=int, default=1, metavar="N",
+        help="worker services behind the session-affine router, one device "
+        "each (forced host devices on CPU, one chip each on a real mesh); "
+        "1 = the single-worker PolicyService path, no router (the "
+        "off-setting determinism anchor)"
+    )
     # Sessions.
-    p.add_argument("--max-sessions", type=int, default=1024)
+    p.add_argument(
+        "--max-sessions", type=int, default=1024,
+        help="session-slab capacity PER WORKER"
+    )
     p.add_argument(
         "--session-ttl", type=float, default=300.0,
         help="seconds of idleness before a session's slot is reclaimed"
@@ -93,8 +104,19 @@ def parse_args(argv=None) -> argparse.Namespace:
 
 
 def build_service(args):
-    """Construct the PolicyService (+ its reloader) from CLI flags."""
-    from r2d2dpg_tpu.serving import CheckpointHotReloader, PolicyService
+    """Construct the serving front end from CLI flags.
+
+    ``--serve-workers 1`` (the default) builds the single-worker
+    ``PolicyService`` exactly as PR 1 did — no router in the path, which is
+    what the off-setting determinism anchor pins.  ``--serve-workers N``
+    replicates the service N times (one device, slab, batcher, and compiled
+    step each) behind the session-affine ``ServiceRouter``.
+    """
+    from r2d2dpg_tpu.serving import (
+        CheckpointHotReloader,
+        PolicyService,
+        build_router,
+    )
     from r2d2dpg_tpu.serving.reload import actor_params_template
     from r2d2dpg_tpu.utils import MetricLogger
 
@@ -111,6 +133,26 @@ def build_service(args):
         poll_every_s=args.poll_every,
     )
     logger = MetricLogger(args.logdir) if args.logdir else None
+    workers = int(getattr(args, "serve_workers", 1) or 1)
+    if workers < 1:
+        raise SystemExit(f"--serve-workers must be >= 1, got {workers}")
+    if workers > 1:
+        # No CSV MetricLogger in routed mode: N workers would interleave
+        # rows in one file.  The labelled r2d2dpg_serve_* registry family
+        # (scrape via --obs-port) and the flight recorder carry per-worker
+        # telemetry instead.
+        service = build_router(
+            actor,
+            num_workers=workers,
+            obs_shape=obs_shape,
+            reloader=reloader,
+            bucket_sizes=[int(b) for b in args.bucket_sizes.split(",")],
+            max_queue=args.max_queue,
+            flush_ms=args.flush_ms,
+            max_sessions=args.max_sessions,
+            session_ttl_s=args.session_ttl,
+        )
+        return service, env
     service = PolicyService(
         actor,
         obs_shape=obs_shape,
@@ -124,6 +166,13 @@ def build_service(args):
         log_every_s=args.log_every_s,
     )
     return service, env
+
+
+def _health_dict(service) -> dict:
+    """JSON-ready health: a PolicyService returns a dataclass snapshot, a
+    ServiceRouter an aggregate dict (with per_worker snapshots) already."""
+    snap = service.health()
+    return snap if isinstance(snap, dict) else dataclasses.asdict(snap)
 
 
 def _serve_stdio(service) -> None:
@@ -146,7 +195,7 @@ def _serve_stdio(service) -> None:
         if cmd == "quit":
             break
         if cmd == "health":
-            print(json.dumps(dataclasses.asdict(service.health())), flush=True)
+            print(json.dumps(_health_dict(service)), flush=True)
             continue
         if cmd == "end_session":
             released = service.end_session(str(msg.get("session", "")))
@@ -188,7 +237,7 @@ def _selftest(service, obs_shape, n: int) -> None:
         req.wait(60.0)
         codes[req.code] = codes.get(req.code, 0) + 1
     print(json.dumps({"selftest": n, "codes": codes,
-                      **dataclasses.asdict(service.health())}), flush=True)
+                      **_health_dict(service)}), flush=True)
 
 
 def main(argv=None) -> None:
@@ -210,8 +259,13 @@ def main(argv=None) -> None:
         obs.get_flight_recorder().install(flight_path)
     if args.obs_port is not None:
         exporter = obs.start_exporter(args.obs_port, host=args.obs_host)
+        # A serving process has no actor fleet: arm /health without the
+        # fleet-telemetry expectation so the serve_* rules judge it alone.
+        exporter.arm_health(
+            obs.HealthEngine(obs.HealthConfig(telem_expected=False))
+        )
         print(
-            f"obs: /metrics + /metrics.json on port {exporter.port}",
+            f"obs: /metrics + /metrics.json + /health on port {exporter.port}",
             file=sys.stderr,
             flush=True,
         )

@@ -9,7 +9,10 @@ Turns a trained R2D2-DPG actor into a request-driven policy service
 - ``reload``    — checkpoint hot-reload polled between batches;
 - ``health``    — queue/latency/staleness snapshot for operators;
 - ``service``   — the orchestrating ``PolicyService`` (one worker thread
-  owns all device work).
+  owns all device work);
+- ``router``    — scale-out: N per-device ``PolicyService`` workers behind
+  a session-affine rendezvous-hash router with broadcast hot-reload
+  (``--serve-workers N``; docs/SERVING.md "Scale-out").
 
 Entry point: ``python -m r2d2dpg_tpu serve --config ... --checkpoint-dir
 ...`` (JSONL over stdio; see serve.py and docs/SERVING.md).
@@ -26,11 +29,20 @@ from r2d2dpg_tpu.serving.batcher import (
 )
 from r2d2dpg_tpu.serving.health import HealthSnapshot
 from r2d2dpg_tpu.serving.reload import CheckpointHotReloader
+from r2d2dpg_tpu.serving.router import (
+    FanoutReloader,
+    ServiceRouter,
+    build_router,
+    default_worker_devices,
+    worker_for,
+)
 from r2d2dpg_tpu.serving.service import (
     BAD_REQUEST,
     INTERNAL_ERROR,
+    PINNED_COMPILER_OPTIONS,
     ActResult,
     PolicyService,
+    compile_pinned,
 )
 from r2d2dpg_tpu.serving.sessions import (
     SessionSlabs,
@@ -43,18 +55,25 @@ __all__ = [
     "ActResult",
     "BAD_REQUEST",
     "CheckpointHotReloader",
+    "FanoutReloader",
     "HealthSnapshot",
     "INTERNAL_ERROR",
     "MicroBatcher",
     "OK",
+    "PINNED_COMPILER_OPTIONS",
     "PolicyService",
     "Request",
     "SHED_QUEUE",
     "SHED_SESSIONS",
     "SHUTDOWN",
+    "ServiceRouter",
     "SessionSlabs",
     "SessionStore",
     "bucket_for",
+    "build_router",
+    "compile_pinned",
+    "default_worker_devices",
     "gather_carries",
     "scatter_carries",
+    "worker_for",
 ]

@@ -55,6 +55,110 @@ from r2d2dpg_tpu.utils.metrics import MetricLogger, PercentileWindow
 BAD_REQUEST = "bad_request"
 INTERNAL_ERROR = "internal_error"
 
+# XLA's backend-optimization pipeline may pick a different reduction
+# strategy per batch shape (and per host-process XLA_FLAGS), which would
+# make a row's served action depend on the bucket it rode in.  Serving
+# pins its executables' compiler options instead, so the bit-identity
+# contract (docs/SERVING.md: same row in, same action out — across
+# buckets, workers, and host flags) holds by construction.
+PINNED_COMPILER_OPTIONS = {"xla_backend_optimization_level": 3}
+
+
+def compile_pinned(jitted, *args):
+    """AOT-compile ``jitted`` at ``args``' shapes under the serving-pinned
+    compiler options (overriding whatever XLA_FLAGS the host set)."""
+    return jitted.lower(*args).compile(
+        compiler_options=PINNED_COMPILER_OPTIONS
+    )
+
+
+class _WorkerInstruments:
+    """Per-worker ``r2d2dpg_serve_*`` registry wiring (router scale-out).
+
+    Registered only when the service runs as a ROUTED worker
+    (``worker_label`` set): the PR-1 single-service path keeps publishing
+    the unlabelled ``r2d2dpg_serving_*`` gauges via
+    ``HealthSnapshot.publish()``, and the two families never collide.  The
+    family is enumerated in ``serving/router.py`` ``METRIC_NAMES`` so
+    ``scripts/lint_obs.sh`` can check registration against declaration the
+    same way it does for the device/quality planes.
+
+    Gauges are pull-time ``set_fn`` closures over plain service attributes
+    (queue depth, slab occupancy, params staleness) — they stay scrapeable
+    after ``stop()`` and cost nothing between scrapes; counters and latency
+    histograms are observed inline on the worker thread's hot path.
+    """
+
+    def __init__(self, service: "PolicyService", label: str, registry=None):
+        from r2d2dpg_tpu.obs import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        self.label = str(label)
+        self._sheds = reg.counter(
+            "r2d2dpg_serve_sheds_total",
+            "requests shed by this worker, by shed code",
+            labelnames=("worker", "code"),
+        )
+        self.requests = reg.counter(
+            "r2d2dpg_serve_requests_total",
+            "requests served OK by this worker",
+            labelnames=("worker",),
+        ).labels(worker=self.label)
+        self.worker_errors = reg.counter(
+            "r2d2dpg_serve_worker_errors_total",
+            "serve-loop failures this worker survived",
+            labelnames=("worker",),
+        ).labels(worker=self.label)
+        self.latency = reg.histogram(
+            "r2d2dpg_serve_latency_seconds",
+            "enqueue->finish latency of OK requests (p50/p99 on scrape)",
+            labelnames=("worker",),
+        ).labels(worker=self.label)
+        self.step = reg.histogram(
+            "r2d2dpg_serve_step_seconds",
+            "device policy-step wall time per batch",
+            labelnames=("worker",),
+        ).labels(worker=self.label)
+        reg.gauge(
+            "r2d2dpg_serve_queue_depth",
+            "requests waiting in this worker's micro-batch queue",
+            labelnames=("worker",),
+        ).labels(worker=self.label).set_fn(
+            lambda: float(service.batcher.depth)
+        )
+        reg.gauge(
+            "r2d2dpg_serve_queue_limit",
+            "this worker's admission bound (max_queue)",
+            labelnames=("worker",),
+        ).labels(worker=self.label).set(float(service.batcher.max_queue))
+        reg.gauge(
+            "r2d2dpg_serve_slab_occupancy",
+            "live sessions / slab capacity on this worker",
+            labelnames=("worker",),
+        ).labels(worker=self.label).set_fn(
+            lambda: service.sessions.active
+            / max(service.sessions.max_sessions, 1)
+        )
+        reg.gauge(
+            "r2d2dpg_serve_params_staleness_seconds",
+            "age of this worker's served params (0 when frozen)",
+            labelnames=("worker",),
+        ).labels(worker=self.label).set_fn(
+            lambda: (
+                service.reloader.staleness_s()
+                if service.reloader is not None
+                else 0.0
+            )
+        )
+        self.params_step = reg.gauge(
+            "r2d2dpg_serve_params_step",
+            "learner step of this worker's served params",
+            labelnames=("worker",),
+        ).labels(worker=self.label)
+
+    def shed(self, code: str) -> None:
+        self._sheds.labels(worker=self.label, code=code).inc()
+
 
 @dataclasses.dataclass(frozen=True)
 class ActResult:
@@ -91,6 +195,9 @@ class PolicyService:
         logger: Optional[MetricLogger] = None,
         log_every_s: float = 10.0,
         clock: Callable[[], float] = time.monotonic,
+        device: Any = None,
+        worker_label: Optional[str] = None,
+        registry: Any = None,
     ):
         if params is None and reloader is None:
             raise ValueError("need initial params or a reloader")
@@ -113,6 +220,15 @@ class PolicyService:
             else params_step
         )
         self._slabs = self.sessions.init_slabs()
+        # Routed workers each pin their state to ONE device (a forced host
+        # device on CPU, one chip on a real mesh).  Committing params and
+        # slabs is enough: jit follows committed arguments, so every policy
+        # step — and its compiled executable — lives on this device without
+        # any cross-worker data movement.
+        self.device = device
+        if device is not None:
+            self._params = jax.device_put(self._params, device)
+            self._slabs = jax.device_put(self._slabs, device)
         step = policy_step_fn(actor)
 
         def _batch_step(p, slabs, slots, obs, reset):
@@ -120,10 +236,11 @@ class PolicyService:
             action, new_carry = step(p, obs, carry, reset)
             return action, scatter_carries(slabs, slots, new_carry)
 
-        # One executable per bucket size (jit caches on shapes); the slabs
-        # are donated through every call — a single live copy in HBM, same
-        # as the trainer donating its arena.
-        self._step = jax.jit(_batch_step, donate_argnums=(1,))
+        # One PINNED executable per bucket size (see compile_pinned); the
+        # slabs are donated through every call — a single live copy in
+        # HBM, same as the trainer donating its arena.
+        self._jit_step = jax.jit(_batch_step, donate_argnums=(1,))
+        self._executables: dict = {}
 
         self._logger = logger
         self._log_every_s = log_every_s
@@ -144,6 +261,25 @@ class PolicyService:
         # Worker-only: locked in by the first served batch when no
         # obs_shape was configured (see the screening in _run_batch).
         self._inferred_obs_shape: Optional[Tuple[int, ...]] = None
+        self.worker_label = (
+            str(worker_label) if worker_label is not None else None
+        )
+        # Flight events from a routed worker carry its label so shed /
+        # reload / error attribution survives into the black-box dump.
+        self._flight_kv = (
+            {"worker": self.worker_label} if self.worker_label else {}
+        )
+        self._obs_serve = (
+            _WorkerInstruments(self, self.worker_label, registry)
+            if self.worker_label is not None
+            else None
+        )
+        if self._obs_serve is not None:
+            self._obs_serve.params_step.set(
+                float(self._params_step)
+                if self._params_step is not None
+                else -1.0
+            )
         self._stats_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -195,6 +331,19 @@ class PolicyService:
             )
         jax.block_until_ready(action)
 
+    def _step(self, params, slabs, slots, obs, reset):
+        """One policy step through the bucket's pinned executable
+        (compiled on first sight of the bucket shape; ``warmup()``
+        pre-populates the cache for every configured bucket)."""
+        key = tuple(obs.shape)
+        exe = self._executables.get(key)
+        if exe is None:
+            exe = compile_pinned(
+                self._jit_step, params, slabs, slots, obs, reset
+            )
+            self._executables[key] = exe
+        return exe(params, slabs, slots, obs, reset)
+
     # ------------------------------------------------------------------- act
     def act_async(
         self, session_id: str, obs: np.ndarray, *, reset: bool = False
@@ -220,7 +369,12 @@ class PolicyService:
             # shutdown doesn't).
             code = SHUTDOWN if self.batcher.closed else SHED_QUEUE
             if code == SHED_QUEUE:
-                flight_event("shed", code=code, session=req.session_id)
+                flight_event(
+                    "shed", code=code, session=req.session_id,
+                    **self._flight_kv,
+                )
+                if self._obs_serve is not None:
+                    self._obs_serve.shed(code)
             req.finish(code, clock=self._clock)
             return req
         return req
@@ -274,7 +428,11 @@ class PolicyService:
         with self._stats_lock:
             self._worker_errors += 1
             self._last_worker_error = f"{type(exc).__name__}: {exc}"
-        flight_event("worker_error", error=self._last_worker_error)
+        flight_event(
+            "worker_error", error=self._last_worker_error, **self._flight_kv
+        )
+        if self._obs_serve is not None:
+            self._obs_serve.worker_errors.inc()
 
     def _recover_from_worker_error(self, exc: Exception, batch) -> None:
         """Fail the affected requests, rebuild device state, keep serving.
@@ -291,6 +449,8 @@ class PolicyService:
                 req.finish(INTERNAL_ERROR, clock=self._clock)
         try:
             self._slabs = self.sessions.init_slabs()
+            if self.device is not None:
+                self._slabs = jax.device_put(self._slabs, self.device)
             self.sessions.clear()
         except Exception as e:  # pragma: no cover - alloc failure is fatal
             with self._stats_lock:
@@ -307,14 +467,21 @@ class PolicyService:
                 self._params = fresh
                 self._params_step = self.reloader.current_step
                 flight_event(
-                    "hot_reload", params_step=int(self._params_step)
+                    "hot_reload", params_step=int(self._params_step),
+                    **self._flight_kv,
                 )
+                if self._obs_serve is not None:
+                    self._obs_serve.params_step.set(float(self._params_step))
         evicted = self.sessions.evict_expired()
         if evicted:
             flight_event("ttl_eviction", count=int(evicted))
         if self._clock() - self._last_obs_t >= self._obs_every_s:
             self._last_obs_t = self._clock()
-            self.health().publish()
+            # Routed workers are fully covered by the labelled serve family
+            # (set_fn gauges + inline counters); the unlabelled serving_*
+            # publish would have N workers overwrite one another.
+            if self._obs_serve is None:
+                self.health().publish()
         if (
             self._logger is not None
             and self._clock() - self._last_log_t >= self._log_every_s
@@ -349,8 +516,11 @@ class PolicyService:
                 with self._stats_lock:
                     self._shed_sessions += 1
                 flight_event(
-                    "shed", code=SHED_SESSIONS, session=req.session_id
+                    "shed", code=SHED_SESSIONS, session=req.session_id,
+                    **self._flight_kv,
                 )
+                if self._obs_serve is not None:
+                    self._obs_serve.shed(SHED_SESSIONS)
                 req.finish(SHED_SESSIONS, clock=self._clock)
                 continue
             slot, is_new = got
@@ -396,6 +566,11 @@ class PolicyService:
         self._step_win.add(step_s)
         for req in admitted:
             self._latency_win.add(req.latency_s)
+        if self._obs_serve is not None:
+            self._obs_serve.requests.inc(n)
+            self._obs_serve.step.observe(step_s)
+            for req in admitted:
+                self._obs_serve.latency.observe(req.latency_s)
 
     # ---------------------------------------------------------------- health
     def health(self) -> HealthSnapshot:

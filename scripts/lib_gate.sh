@@ -168,6 +168,10 @@ run_evidence() {
         echo "$dir: experience-quality gate FAILED (attempt $attempt)"
         continue
       fi
+      if ! serve_gate "$dir" "$@"; then
+        echo "$dir: serving scale-out gate FAILED (attempt $attempt)"
+        continue
+      fi
       timeout --kill-after=30 --signal=TERM 1800 \
         env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
         python -m r2d2dpg_tpu.eval $evalflags \
@@ -796,6 +800,58 @@ quality_gate() {
     return 1
   fi
   return 0
+}
+
+# Serving scale-out gate (ISSUE 20): an evidence dir produced with
+# --serve-workers N (N >= 2, e.g. a BENCH_SERVE traffic run or a routed
+# serve deployment's obs capture) may only be blessed if the off-setting
+# anchors pass on this checkout — the 1-worker router path bit-identical
+# to the PR-1 PolicyService through the serve CLI, interleaved routed
+# traffic bit-identical per session to sequential rollouts, and the
+# rendezvous hash's determinism/coverage pins (docs/SERVING.md
+# "Scale-out").  A routed p50/p99 number over traffic that silently lost
+# a session's carry to an affinity bug is not evidence.  The resolved
+# worker count is stamped into the evidence dir (serve_workers.txt)
+# beside the other topology stamps, so a blessed number always says how
+# many workers served it.  Same stamping discipline as fleet_gate;
+# single-worker runs pass through untouched.
+#   serve_gate <dir> <serve/bench args...>
+serve_gate() {
+  local dir=$1
+  shift
+  local _sw="" _sw_prev=""
+  local _sw_arg
+  for _sw_arg in "$@"; do
+    # Both argparse spellings: "--flag value" and "--flag=value".
+    case "$_sw_arg" in
+      --serve-workers=*) _sw=${_sw_arg#*=} ;;
+    esac
+    case "$_sw_prev" in
+      --serve-workers) _sw=$_sw_arg ;;
+    esac
+    _sw_prev=$_sw_arg
+  done
+  if [ -z "$_sw" ] || [ "$_sw" = 0 ] || [ "$_sw" = 1 ]; then
+    return 0  # single-worker (or non-serve) run: nothing to gate
+  fi
+  printf 'serve_workers=%s\n' "$_sw" > "$dir/serve_workers.txt"
+  if [ -f "$dir/.serve_anchor_ok" ]; then
+    return 0
+  fi
+  # XLA_FLAGS cleared like every gate pytest line: a serve evidence run
+  # exports forced host devices, and an inherited count breaks
+  # tests/conftest.py's device assert during collection.
+  if timeout --kill-after=30 900 \
+       env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu R2D2DPG_PALLAS_INTERPRET=1 \
+       XLA_FLAGS= \
+       python -m pytest tests/test_serve_router.py tests/test_serve_cli.py \
+         -q -p no:cacheprovider -m 'not slow' \
+         -k 'bit_identical or affine or rendezvous' \
+       > "$dir/serve_gate.log" 2>&1; then
+    touch "$dir/.serve_anchor_ok"
+    return 0
+  fi
+  return 1
 }
 
 gate_on_box() {

@@ -112,6 +112,28 @@ if Path("r2d2dpg_tpu/obs/quality.py").exists():
                 f"r2d2dpg_tpu/obs/quality.py: {name} registered but "
                 "missing from METRIC_NAMES"
             )
+# The serving family (serving/router.py METRIC_NAMES, ISSUE 20): same
+# contract again, but the registrations SPAN two modules (the router's
+# fleet-level instruments plus service.py's per-worker _WorkerInstruments)
+# so the reverse check scans the whole serving/ package — a
+# r2d2dpg_serve_* registration anywhere in it missing from the router's
+# METRIC_NAMES is an offence.
+if Path("r2d2dpg_tpu/serving/router.py").exists():
+    from r2d2dpg_tpu.serving.router import (  # noqa: E402
+        METRIC_NAMES as SERVE_NAMES,
+    )
+
+    for name in SERVE_NAMES:
+        if not scheme.match(name) and name not in allow:
+            bad.append(f"r2d2dpg_tpu/serving/router.py: {name}")
+    declared = set(SERVE_NAMES)
+    for path in sorted(Path("r2d2dpg_tpu/serving").rglob("*.py")):
+        for name in pat.findall(path.read_text()):
+            if name.startswith("r2d2dpg_serve_") and name not in declared:
+                bad.append(
+                    f"{path}: {name} registered but missing from "
+                    "serving/router.py METRIC_NAMES"
+                )
 if bad:
     print("\n".join(bad))
     print(

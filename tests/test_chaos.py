@@ -595,10 +595,16 @@ def test_chaos_multi_fault_drill_in_process_e2e(tmp_path):
         trainer,
         FleetConfig(
             num_actors=num_actors,
-            # Deep queue: handlers never park in a queue-full wait while
-            # the drain program compiles, so acks stay prompt and the
-            # short heartbeat below only ever fires on REAL silence.
-            queue_depth=32,
+            # Deep queue: handlers never park in a queue-full wait, so
+            # acks stay prompt, the short heartbeat below only ever fires
+            # on REAL silence, and a parked handler can never miss the
+            # stall drill's reap window.  Sized ~3x past what the actors
+            # can produce over the whole run on a slow 1-core box
+            # (~120 tiny batches/s for ~45 s), so zero sheds holds by
+            # construction; the actors' effectively-unbounded max_phases
+            # below keeps them connected (and the conn-kill drill
+            # targetable) until the learner's schedule completes.
+            queue_depth=16384,
             idle_timeout_s=120,
             heartbeat_s=0.75,
             warmup_deadline_s=60,
@@ -623,7 +629,7 @@ def test_chaos_multi_fault_drill_in_process_e2e(tmp_path):
 
     def actor_loop(a):
         try:
-            a.run(max_phases=400)
+            a.run(max_phases=1_000_000)  # outlive the learner's schedule
         except Exception:  # noqa: BLE001 — server teardown cuts the socket
             pass
 

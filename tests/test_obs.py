@@ -1323,6 +1323,108 @@ def test_health_engine_eviction_churn_ignores_sub_window_poll_gaps():
     ]
 
 
+def test_health_engine_serve_queue_saturated_warmup_exempt_per_worker():
+    """serve_queue_saturated judges each routed worker against ITS
+    admission bound, but only after that worker has served >= 1 request
+    — admission legitimately piles while the first bucket compiles."""
+    reg, engine = _snap_engine(serve_queue_saturated_frac=0.9)
+    # No routed serving workers in this process: rule disarmed.
+    assert engine.evaluate()["verdict"] == "ok"
+    depth = reg.gauge("r2d2dpg_serve_queue_depth", labelnames=("worker",))
+    limit = reg.gauge("r2d2dpg_serve_queue_limit", labelnames=("worker",))
+    served = reg.counter(
+        "r2d2dpg_serve_requests_total", labelnames=("worker",)
+    )
+    depth.labels(worker="0").set(95.0)
+    limit.labels(worker="0").set(100.0)
+    # Warm-up exemption: saturated depth, zero requests served yet.
+    assert not [
+        f
+        for f in engine.evaluate()["findings"]
+        if f["rule"] == "serve_queue_saturated"
+    ]
+    served.labels(worker="0").inc(1)
+    found = [
+        f
+        for f in engine.evaluate()["findings"]
+        if f["rule"] == "serve_queue_saturated"
+    ]
+    assert len(found) == 1 and "worker 0" in found[0]["detail"]
+    assert found[0]["value"] == 95.0 and found[0]["threshold"] == 90.0
+    # A second, healthy worker contributes nothing (per-worker dedupe).
+    depth.labels(worker="1").set(5.0)
+    limit.labels(worker="1").set(100.0)
+    served.labels(worker="1").inc(10)
+    found = [
+        f
+        for f in engine.evaluate()["findings"]
+        if f["rule"] == "serve_queue_saturated"
+    ]
+    assert len(found) == 1 and "worker 0" in found[0]["detail"]
+    # Draining clears the finding; the firing series reads an explicit 0.
+    depth.labels(worker="0").set(10.0)
+    assert not [
+        f
+        for f in engine.evaluate()["findings"]
+        if f["rule"] == "serve_queue_saturated"
+    ]
+    firing = reg.get("r2d2dpg_health_rule_firing")
+    assert firing.labels(rule="serve_queue_saturated").value == 0.0
+
+
+def test_health_engine_serve_shed_churn_rate_per_worker():
+    """serve_shed_churn is a windowed per-worker rate over the summed
+    shed codes: the finding names the shedding worker, other workers
+    stay quiet, and the first sighting only opens the baseline window."""
+    import time as _time
+
+    reg, engine = _snap_engine(
+        serve_shed_per_s=1.0, serve_shed_rate_min_dt_s=0.0
+    )
+    sheds = reg.counter(
+        "r2d2dpg_serve_sheds_total", labelnames=("worker", "code")
+    )
+    sheds.labels(worker="0", code="shed_queue_full").inc(0)
+    sheds.labels(worker="1", code="shed_queue_full").inc(0)
+    # First sighting: baseline window opens, nothing fires.
+    assert not [
+        f
+        for f in engine.evaluate()["findings"]
+        if f["rule"] == "serve_shed_churn"
+    ]
+    _time.sleep(0.02)
+    # Both shed MODES of worker 0 count toward its one rate.
+    sheds.labels(worker="0", code="shed_queue_full").inc(600)
+    sheds.labels(worker="0", code="shed_session_capacity").inc(400)
+    found = [
+        f
+        for f in engine.evaluate()["findings"]
+        if f["rule"] == "serve_shed_churn"
+    ]
+    assert len(found) == 1 and "worker 0" in found[0]["detail"]
+    assert found[0]["value"] > 1.0
+
+
+def test_health_engine_serve_shed_churn_ignores_sub_window_poll_gaps():
+    """Sheds land in bursts (a full queue refuses a whole arrival wave):
+    a burst over a sub-second poll gap re-judges the last FULL window —
+    the eviction_churn burst guard, per worker."""
+    reg, engine = _snap_engine(
+        serve_shed_per_s=1.0, serve_shed_rate_min_dt_s=5.0
+    )
+    cell = reg.counter(
+        "r2d2dpg_serve_sheds_total", labelnames=("worker", "code")
+    ).labels(worker="0", code="shed_queue_full")
+    cell.inc(0)
+    engine.evaluate()  # baseline window opens
+    cell.inc(64)  # one refusal burst, operator curl 20ms later
+    assert not [
+        f
+        for f in engine.evaluate()["findings"]
+        if f["rule"] == "serve_shed_churn"
+    ]
+
+
 def test_health_engine_telem_stale_needs_armed_cadence():
     """Staleness clocks arm at HELLO whether or not the peers were told
     to push TELEM (--telem-every rides --obs-fleet): with

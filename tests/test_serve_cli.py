@@ -8,7 +8,7 @@ import sys
 
 import pytest
 
-from r2d2dpg_tpu.serve import parse_args
+from r2d2dpg_tpu.serve import build_service, parse_args
 
 pytestmark = pytest.mark.serving
 
@@ -27,6 +27,94 @@ def test_parse_args_plumbing():
     assert args.bucket_sizes == "2,8" and args.flush_ms == 1.5
     assert (args.max_queue, args.max_sessions) == (7, 3)
     assert (args.session_ttl, args.poll_every) == (9.0, 0.5)
+    assert args.serve_workers == 1  # scale-out is opt-in
+    assert parse_args(
+        ["--config", "pendulum_tiny", "--checkpoint-dir", "ck",
+         "--serve-workers", "4"]
+    ).serve_workers == 4
+
+
+def _cli_args(ckpt_dir, *extra):
+    return parse_args(
+        ["--config", "pendulum_tiny", "--checkpoint-dir", ckpt_dir,
+         "--bucket-sizes", "1,2", "--flush-ms", "1", *extra]
+    )
+
+
+def test_build_service_workers_flag_selects_plain_service_or_router(ckpt_dir):
+    """Structural half of the off-setting anchor: ``--serve-workers 1``
+    (default or explicit) builds the PR-1 single-worker PolicyService with
+    NO router and NO worker label in the path; ``--serve-workers N``
+    builds the session-affine router over N labelled per-device workers
+    sharing one fanout reloader."""
+    from r2d2dpg_tpu.serving import PolicyService, ServiceRouter
+    from r2d2dpg_tpu.serving.router import FanoutReloader
+
+    for argv_extra in ((), ("--serve-workers", "1")):
+        svc, _env = build_service(_cli_args(ckpt_dir, *argv_extra))
+        assert type(svc) is PolicyService
+        assert svc.worker_label is None and svc.device is None
+
+    router, _env = build_service(_cli_args(ckpt_dir, "--serve-workers", "2"))
+    assert type(router) is ServiceRouter and router.num_workers == 2
+    fanouts = set()
+    for w, svc in enumerate(router.services):
+        assert svc.worker_label == str(w)
+        assert svc.device is not None
+        fanouts.add(id(svc.reloader._fanout))
+        assert isinstance(svc.reloader._fanout, FanoutReloader)
+    assert len(fanouts) == 1, "workers must share ONE checkpoint poller"
+
+
+def test_serve_workers_1_bit_identical_to_pr1_path(ckpt_dir):
+    """Determinism half of the anchor: the CLI-built ``--serve-workers 1``
+    service serves the exact bits a directly-constructed PR-1
+    PolicyService serves for the same traffic."""
+    import numpy as np
+
+    from r2d2dpg_tpu.configs import get_config
+    from r2d2dpg_tpu.serving import CheckpointHotReloader, PolicyService
+    from r2d2dpg_tpu.serving.reload import actor_params_template
+
+    cfg = get_config("pendulum_tiny")
+    env = cfg.env_factory()
+    actor = cfg.build_agent(env).actor
+    obs_shape = tuple(env.spec.obs_shape)
+    rng = np.random.default_rng(5)
+    sids = ["a", "b", "c"]
+    obs = {
+        s: rng.standard_normal((3,) + obs_shape).astype(np.float32)
+        for s in sids
+    }
+
+    def drive(service):
+        got = {s: [] for s in sids}
+        with service:
+            for t in range(3):
+                pending = [
+                    (s, service.act_async(s, obs[s][t], reset=(t == 0)))
+                    for s in sids
+                ]
+                for s, req in pending:
+                    assert req.wait(30.0) and req.code == "ok", req.code
+                    got[s].append(req.action)
+        return got
+
+    via_cli, _ = build_service(_cli_args(ckpt_dir, "--serve-workers", "1"))
+    pr1 = PolicyService(
+        actor,
+        obs_shape=obs_shape,
+        bucket_sizes=(1, 2),
+        flush_ms=1.0,
+        reloader=CheckpointHotReloader(
+            ckpt_dir, actor_params_template(actor, obs_shape),
+            poll_every_s=2.0,
+        ),
+    )
+    got_cli, got_pr1 = drive(via_cli), drive(pr1)
+    for s in sids:
+        for t in range(3):
+            np.testing.assert_array_equal(got_cli[s][t], got_pr1[s][t])
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,400 @@
+"""Serving scale-out tests (ISSUE 20): session-affine router over N
+per-device workers.
+
+Covers the tentpole contracts:
+
+- consistent-hash determinism: same session id -> same worker across
+  calls, router instances, and process restarts (crc32 is unsalted;
+  golden values pin the algorithm itself);
+- affinity: interleaved traffic through a 2-worker router is
+  bit-identical per session to sequential unbatched rollouts (the carry
+  lives on exactly one worker's slab) with zero violations;
+- off-setting anchor: a 1-worker router serves bit-identically to the
+  plain PR-1 ``PolicyService`` (the CLI-level anchor lives in
+  test_serve_cli.py);
+- hot-reload broadcast: ONE restore reaches ALL workers between batches,
+  no session loss, carries continuous across the swap;
+- shed attribution: an overloaded worker's sheds land on ITS ``worker=``
+  label in the registry.
+
+All nets use action_dim >= 3: XLA:CPU lowers a single-column output head
+through a gemv whose reduction order is batch-size dependent (see
+docs/SERVING.md "Determinism").
+"""
+
+import collections
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from r2d2dpg_tpu.models import ActorNet, policy_step_fn
+from r2d2dpg_tpu.obs.registry import Registry
+from r2d2dpg_tpu.serving import (
+    OK,
+    SHED_QUEUE,
+    PolicyService,
+    ServiceRouter,
+    build_router,
+    compile_pinned,
+    worker_for,
+)
+from r2d2dpg_tpu.serving.router import FanoutReloader
+
+pytestmark = pytest.mark.serving
+
+OBS = (5,)
+ACT = 3
+
+
+@functools.lru_cache(maxsize=None)
+def make_actor(hidden=16):
+    # Cached: one actor instance across the module so the reference-step
+    # jit below is compiled ONCE, not once per test (tier-1 runs close to
+    # its wall budget; every throwaway trace counts).
+    return ActorNet(action_dim=ACT, hidden=hidden, use_lstm=True)
+
+
+_STEP_CACHE = {}
+
+
+def ref_step(actor, args):
+    """One PINNED batch-1 policy-step executable per (cached) actor —
+    compiled via ``compile_pinned`` so the reference runs under the same
+    compiler options the routed workers pin, whatever XLA_FLAGS the suite
+    sets.  Cached because ``policy_step_fn`` returns a fresh closure per
+    call, so a naive per-test compile would re-trace every time."""
+    exe = _STEP_CACHE.get(id(actor))
+    if exe is None:
+        exe = _STEP_CACHE.setdefault(
+            id(actor),
+            compile_pinned(jax.jit(policy_step_fn(actor)), *args),
+        )
+    return exe
+
+
+def init_params(actor, seed=1):
+    return actor.init(
+        jax.random.PRNGKey(seed),
+        jnp.zeros((1,) + OBS),
+        actor.initial_carry(1),
+        jnp.zeros((1,)),
+    )
+
+
+def make_router(actor, params=None, *, num_workers=2, reloader=None, **kw):
+    kw.setdefault("obs_shape", OBS)
+    kw.setdefault("max_sessions", 8)
+    kw.setdefault("bucket_sizes", (1, 2))
+    kw.setdefault("flush_ms", 1.0)
+    kw.setdefault("registry", Registry())
+    return build_router(
+        actor,
+        num_workers=num_workers,
+        params=params,
+        reloader=reloader,
+        **kw,
+    )
+
+
+def reference_rollout(actor, params, obs_seq):
+    """Sequential UNBATCHED rollout: the ground truth serving must match."""
+    carry = actor.initial_carry(1)
+    out = []
+    for t in range(obs_seq.shape[0]):
+        args = (
+            params,
+            obs_seq[t][None],
+            carry,
+            jnp.asarray([1.0 if t == 0 else 0.0]),
+        )
+        a, carry = ref_step(actor, args)(*args)
+        out.append(np.asarray(a[0]))
+    return out
+
+
+class FakeReloader:
+    """In-memory stand-in for CheckpointHotReloader (same duck type).
+
+    ``restores`` counts how many times a version was actually "read from
+    disk" — the broadcast tests pin that N workers cost ONE restore.
+    """
+
+    def __init__(self, params, step=1):
+        self._latest = (params, int(step))
+        self.current_step = None
+        self.last_error = None
+        self.reloads = 0
+        self.restores = 0
+
+    def publish(self, params, step):
+        self._latest = (params, int(step))
+
+    def load_latest(self):
+        params, step = self._latest
+        self.current_step = step
+        self.restores += 1
+        self.reloads += 1
+        return params
+
+    def poll(self):
+        params, step = self._latest
+        if step == self.current_step:
+            return None
+        self.current_step = step
+        self.restores += 1
+        self.reloads += 1
+        return params
+
+    def staleness_s(self):
+        return 0.0
+
+
+# ------------------------------------------------------------ hash routing
+def test_worker_for_rendezvous_determinism_and_coverage():
+    # Stable across calls and across router instances (the hash is the
+    # routing table — there is no state to lose on restart).
+    sids = [f"user-{i}" for i in range(512)]
+    for n in (1, 2, 3, 8):
+        first = [worker_for(s, n) for s in sids]
+        assert first == [worker_for(s, n) for s in sids]
+        assert all(0 <= w < n for w in first)
+        if n > 1:
+            # Every worker sees traffic: 512 sessions cannot all pile on
+            # one device unless the hash is broken.
+            assert len(set(first)) == n
+    # Golden pins: crc32 is unsalted and platform-stable, so these exact
+    # assignments survive any restart — drift here means the algorithm
+    # changed and EVERY live session's carry is about to be orphaned.
+    assert [worker_for(s, 4) for s in ("alice", "bob", "carol", "dave")] == [
+        0, 1, 2, 3,
+    ]
+    # Prefix-sharing ids must NOT cluster (the raw-crc32 XOR-linearity
+    # failure mode: sequential user ids all piling onto one worker).
+    for n in (2, 4):
+        seq = [worker_for(f"user-{i}", n) for i in range(64)]
+        assert len(set(seq)) == n
+    # Rendezvous property: growing the fleet moves only the sessions the
+    # new worker wins — most pins survive a resize.
+    before = {s: worker_for(s, 4) for s in sids}
+    after = {s: worker_for(s, 5) for s in sids}
+    moved = sum(1 for s in sids if before[s] != after[s])
+    assert 0 < moved < len(sids) // 2
+    kept = [s for s in sids if before[s] == after[s]]
+    assert all(after[s] == before[s] for s in kept)
+    with pytest.raises(ValueError):
+        worker_for("x", 0)
+
+
+def test_router_sessions_stay_affine_and_bit_identical():
+    """THE affinity contract: interleaved traffic over 2 workers, every
+    session's action stream bit-identical to its sequential unbatched
+    rollout (possible only if each session's carry stayed on exactly one
+    worker), zero violations, and slab residency matching the hash."""
+    actor = make_actor()
+    params = init_params(actor)
+    rng = np.random.default_rng(3)
+    sids = [f"client-{i}" for i in range(6)]
+    obs = {
+        s: rng.standard_normal((6,) + OBS).astype(np.float32) for s in sids
+    }
+    served = {s: [] for s in sids}
+    router = make_router(actor, params)
+    with router:
+        for t in range(6):
+            pending = [
+                (s, router.act_async(s, obs[s][t], reset=(t == 0)))
+                for s in sids
+            ]
+            for s, req in pending:
+                assert req.wait(30.0), "request dropped"
+                assert req.code == OK, req.code
+                served[s].append(req.action)
+        # Residency: each session's slot lives on (only) its hash worker.
+        expected = collections.Counter(worker_for(s, 2) for s in sids)
+        for w, svc in enumerate(router.services):
+            assert svc.sessions.active == expected[w]
+    assert router.affinity_violations == 0
+    h = router.health()
+    assert h["workers"] == 2 and h["requests_ok"] == 36
+    assert h["requests_shed"] == 0 and h["affinity_violations"] == 0
+    for s in sids:
+        want = reference_rollout(actor, params, obs[s])
+        for t in range(6):
+            np.testing.assert_array_equal(served[s][t], want[t])
+
+
+def test_router_one_worker_bit_identical_to_plain_service():
+    """Off-setting determinism anchor, in-process half: a 1-worker router
+    is the same computation as the PR-1 PolicyService, bit for bit."""
+    actor = make_actor()
+    params = init_params(actor)
+    rng = np.random.default_rng(11)
+    sids = ["a", "b", "c"]
+    obs = {
+        s: rng.standard_normal((4,) + OBS).astype(np.float32) for s in sids
+    }
+
+    def drive(service):
+        got = {s: [] for s in sids}
+        with service:
+            for t in range(4):
+                pending = [
+                    (s, service.act_async(s, obs[s][t], reset=(t == 0)))
+                    for s in sids
+                ]
+                for s, req in pending:
+                    assert req.wait(30.0) and req.code == OK
+                    got[s].append(req.action)
+        return got
+
+    plain = drive(
+        PolicyService(
+            actor,
+            params,
+            obs_shape=OBS,
+            max_sessions=8,
+            bucket_sizes=(1, 2),
+            flush_ms=1.0,
+        )
+    )
+    routed = drive(make_router(actor, params, num_workers=1))
+    for s in sids:
+        for t in range(4):
+            np.testing.assert_array_equal(routed[s][t], plain[s][t])
+
+
+# ------------------------------------------------------------- hot reload
+def test_hot_reload_broadcasts_to_all_workers_without_session_loss():
+    """Mid-stream param swap reaches BOTH workers between batches: every
+    session serves v2 after the swap with carry continuity (bit-identical
+    replay against its observed params schedule), nobody is dropped, and
+    the fanout pays exactly ONE restore for the broadcast."""
+    actor = make_actor()
+    params_by_step = {1: init_params(actor, 1), 2: init_params(actor, 2)}
+    base = FakeReloader(params_by_step[1], step=1)
+    rng = np.random.default_rng(7)
+    sids = [f"s{i}" for i in range(4)]
+    # 4 sids spread over both workers (pinned so the test can't silently
+    # degenerate to single-worker coverage).
+    spread = {worker_for(s, 2) for s in sids}
+    assert spread == {0, 1}
+    obs = {
+        s: rng.standard_normal((8,) + OBS).astype(np.float32) for s in sids
+    }
+    served = {s: [] for s in sids}
+    router = make_router(actor, reloader=base)
+    with router:
+        for t in range(8):
+            if t == 3:
+                base.publish(params_by_step[2], step=2)
+            pending = [
+                (s, router.act_async(s, obs[s][t], reset=(t == 0)))
+                for s in sids
+            ]
+            for s, req in pending:
+                assert req.wait(30.0), "request dropped across reload"
+                assert req.code == OK, req.code
+                served[s].append((req.params_step, req.action))
+        h = router.health()
+    # Both workers swapped: the broadcast reached every device...
+    for snap in h["per_worker"].values():
+        assert snap["params_step"] == 2
+    # ...off ONE restore (load_latest) + ONE poll restore — not one per
+    # worker: that is the whole point of the fanout.
+    assert base.restores == 2
+    for s in sids:
+        steps = [ps for ps, _ in served[s]]
+        assert steps[0] == 1 and steps[-1] == 2
+        assert steps == sorted(steps), "params rolled back mid-session"
+        # Carry continuity across the swap: replay sequentially against
+        # the exact schedule this session observed.
+        carry = actor.initial_carry(1)
+        for t, (ps, action) in enumerate(served[s]):
+            args = (
+                params_by_step[ps],
+                obs[s][t][None],
+                carry,
+                jnp.asarray([1.0 if t == 0 else 0.0]),
+            )
+            want, carry = ref_step(actor, args)(*args)
+            np.testing.assert_array_equal(action, np.asarray(want[0]))
+    assert router.affinity_violations == 0
+
+
+def test_fanout_reloader_views_apply_lazily_and_once():
+    actor = make_actor()
+    p1, p2 = init_params(actor, 1), init_params(actor, 2)
+    base = FakeReloader(p1, step=1)
+    fan = FanoutReloader(base)
+    views = [fan.view(), fan.view(), fan.view()]
+    for v in views:
+        v.load_latest()
+        assert v.current_step == 1
+    assert base.restores == 1  # initial load shared by all three
+    base.publish(p2, step=2)
+    assert views[0].poll() is not None and views[0].current_step == 2
+    assert base.restores == 2
+    # The other views pick the cached version up without a base restore.
+    for v in views[1:]:
+        assert v.poll() is not None and v.current_step == 2
+    assert base.restores == 2
+    # Quiescent: nobody re-applies.
+    assert all(v.poll() is None for v in views)
+    assert base.restores == 2
+
+
+# ------------------------------------------------------------------ sheds
+def test_shed_attribution_lands_on_the_hashed_worker_label():
+    """max_queue=0 makes every submit shed at the door; each shed must be
+    counted under the worker the session HASHES to — per-worker
+    attribution is what lets an operator see one saturated device."""
+    actor = make_actor()
+    params = init_params(actor)
+    reg = Registry()
+    sids = [f"u{i}" for i in range(16)]
+    expected = collections.Counter(str(worker_for(s, 2)) for s in sids)
+    router = make_router(
+        actor, params, max_queue=0, registry=reg
+    )
+    router.start(warmup=False)  # no batches will ever run: skip compiles
+    try:
+        for s in sids:
+            req = router.act_async(s, np.zeros(OBS, np.float32))
+            assert req.code == SHED_QUEUE
+    finally:
+        router.stop()
+    sheds = reg.get("r2d2dpg_serve_sheds_total")
+    for w in ("0", "1"):
+        assert sheds.labels(
+            worker=w, code=SHED_QUEUE
+        ).value == float(expected[w])
+    # Nothing leaked onto the wrong label, and the router saw no
+    # affinity violations while shedding.
+    assert sum(expected.values()) == len(sids)
+    assert router.affinity_violations == 0
+    assert reg.get("r2d2dpg_serve_workers").value == 2.0
+
+
+def test_router_end_session_routes_and_unpins():
+    actor = make_actor()
+    params = init_params(actor)
+    router = make_router(actor, params)
+    with router:
+        req = router.act_async("goodbye", np.zeros(OBS, np.float32),
+                               reset=True)
+        assert req.wait(30.0) and req.code == OK
+        w = worker_for("goodbye", 2)
+        assert router.services[w].sessions.active == 1
+        assert router.end_session("goodbye")
+        assert router.services[w].sessions.active == 0
+        assert not router.end_session("never-seen")
+
+
+def test_router_requires_workers():
+    with pytest.raises(ValueError):
+        ServiceRouter([])
+    with pytest.raises(ValueError):
+        build_router(make_actor(), num_workers=0, params=None)

@@ -23,6 +23,7 @@ from r2d2dpg_tpu.serving import (
     bucket_for,
 )
 from r2d2dpg_tpu.serving.batcher import OK, SHED_QUEUE, SHED_SESSIONS
+from r2d2dpg_tpu.serving.service import compile_pinned
 from r2d2dpg_tpu.utils.metrics import PercentileWindow
 
 pytestmark = pytest.mark.serving
@@ -55,17 +56,25 @@ def make_service(actor=None, params=None, **kw):
 
 
 def reference_rollout(actor, params, obs_seq):
-    """Sequential UNBATCHED rollout: the ground truth serving must match."""
+    """Sequential UNBATCHED rollout: the ground truth serving must match.
+
+    Compiled through ``compile_pinned`` so the reference runs under the
+    SAME compiler options the service pins — the conftest is free to dial
+    XLA's backend level for suite speed without touching this contract."""
     carry = actor.initial_carry(1)
     step = jax.jit(policy_step_fn(actor))
     out = []
+    exe = None
     for t in range(obs_seq.shape[0]):
-        a, carry = step(
+        args = (
             params,
             obs_seq[t][None],
             carry,
             jnp.asarray([1.0 if t == 0 else 0.0]),
         )
+        if exe is None:
+            exe = compile_pinned(step, *args)
+        a, carry = exe(*args)
         out.append(np.asarray(a[0]))
     return out
 
@@ -179,7 +188,10 @@ def test_feedforward_actor_serves_too():
     with make_service(actor, params) as svc:
         res = svc.act("x", obs)
     assert res.code == OK
-    direct, _ = actor.apply(params, obs[None], (), jnp.zeros((1,)))
+    # Pinned like every serving reference: an eager apply would dispatch
+    # op-by-op under the suite's XLA_FLAGS instead.
+    args = (params, obs[None], (), jnp.zeros((1,)))
+    direct, _ = compile_pinned(jax.jit(actor.apply), *args)(*args)
     np.testing.assert_array_equal(res.action, np.asarray(direct[0]))
 
 

@@ -20,7 +20,11 @@ import pytest
 
 from r2d2dpg_tpu.configs import get_config
 from r2d2dpg_tpu.models import policy_step_fn
-from r2d2dpg_tpu.serving import CheckpointHotReloader, PolicyService
+from r2d2dpg_tpu.serving import (
+    CheckpointHotReloader,
+    PolicyService,
+    compile_pinned,
+)
 from r2d2dpg_tpu.serving.batcher import OK
 from r2d2dpg_tpu.serving.reload import actor_params_template
 from r2d2dpg_tpu.utils.checkpoint import CheckpointManager, abstract_template
@@ -184,16 +188,22 @@ def test_e2e_interleaved_sessions_with_midstream_hot_reload(tmp_path):
     # Bit-identical to sequential unbatched rollouts replayed against the
     # exact params schedule each session observed — INCLUDING carry
     # continuity across the swap (the reload must not touch session state).
+    # The reference compiles through compile_pinned: same compiler options
+    # as the service, independent of the suite's XLA_FLAGS.
     step = jax.jit(policy_step_fn(actor))
+    exe = None
     for s in sessions:
         carry = actor.initial_carry(1)
         for t, (ps, action) in enumerate(served[s]):
-            want, carry = step(
+            args = (
                 params_by_step[ps],
                 obs[s][t][None],
                 carry,
                 jnp.asarray([1.0 if t == 0 else 0.0]),
             )
+            if exe is None:
+                exe = compile_pinned(step, *args)
+            want, carry = exe(*args)
             np.testing.assert_array_equal(action, np.asarray(want[0]))
 
 

@@ -342,7 +342,17 @@ def test_data_plane_seqs_auth_and_byte_separation(fresh_obs):
             kind, payload = recv_frame(sock)
         advert = unpack_obj(payload)  # wire-lint: control
         assert advert["occupancy"] == 3
+        # The byte counters land AFTER each send_frame returns, so the
+        # handler thread can still owe a count when our recv completes —
+        # settle the baseline before pinning it.
         d_in, d_out = data_totals()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            time.sleep(0.02)
+            cur = data_totals()
+            if cur == (d_in, d_out):
+                break
+            d_in, d_out = cur
         assert d_in > 0 and d_out > 0
         # The shed forward hop, as a counter: nothing crossed the
         # learner's ingest leg.
